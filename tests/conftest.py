@@ -1,0 +1,24 @@
+"""Test harness: run everything on a CPU-simulated 8-device mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on virtual CPU devices (`--xla_force_host_platform_device_count`),
+the standard JAX technique for SPMD tests. Must run before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

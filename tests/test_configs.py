@@ -1,0 +1,138 @@
+"""L0 config tests: YAML parsing, method registry, reference-field parity."""
+
+import textwrap
+
+import pytest
+
+from trlx_tpu.data.configs import ModelSpec, TRLConfig
+from trlx_tpu.data.method_configs import ILQLConfig, PPOConfig, get_method, register_method, MethodConfig
+
+
+PPO_YAML = textwrap.dedent(
+    """
+    model:
+      model_path: "lvwerra/gpt2-imdb"
+      tokenizer_path: "gpt2"
+      model_type: "JaxPPOTrainer"
+      device: "cuda"
+      num_layers_unfrozen: 2
+
+    train:
+      n_ctx: 512
+      epochs: 10
+      total_steps: 80000
+      batch_size: 128
+      grad_clip: 1.0
+      lr_ramp_steps: 100
+      lr_decay_steps: 79000
+      weight_decay: 1.0e-6
+      learning_rate_init: 1.412e-4
+      learning_rate_target: 1.412e-4
+      log_interval: 25
+      checkpoint_interval: 1000000
+      eval_interval: 16
+      pipeline: "PPOPipeline"
+      orchestrator: "PPOOrchestrator"
+      input_size: 4
+      gen_size: 48
+      accelerate: True
+      accelerate_config_path: ""
+
+    method:
+      name: 'ppoconfig'
+      num_rollouts: 128
+      chunk_size: 128
+      ppo_epochs: 4
+      init_kl_coef: 0.2
+      target: 6
+      horizon: 10000
+      gamma: 1
+      lam: 0.95
+      cliprange: 0.2
+      cliprange_value: 0.2
+      vf_coef: 2.3
+      gen_kwargs:
+        max_length: 48
+        min_length: 48
+        top_k: 0.0
+        top_p: 1.0
+        do_sample: True
+    """
+)
+
+
+def test_load_reference_style_yaml(tmp_path):
+    p = tmp_path / "ppo.yml"
+    p.write_text(PPO_YAML)
+    cfg = TRLConfig.load_yaml(str(p))
+    assert cfg.model.num_layers_unfrozen == 2
+    assert cfg.train.batch_size == 128
+    assert cfg.train.gen_size == 48
+    assert isinstance(cfg.method, PPOConfig)
+    assert cfg.method.vf_coef == 2.3
+    assert cfg.method.gen_kwargs["max_length"] == 48
+    # ignored-but-accepted legacy fields
+    assert cfg.model.device == "cuda"
+    d = cfg.to_dict()
+    assert d["cliprange"] == 0.2 and d["n_ctx"] == 512
+
+
+def test_method_registry_case_insensitive():
+    assert get_method("PPOConfig") is PPOConfig
+    assert get_method("ilqlconfig") is ILQLConfig
+    with pytest.raises(KeyError):
+        get_method("nope")
+
+
+def test_register_custom_method():
+    @register_method("customtest")
+    class CustomConfig(MethodConfig):
+        pass
+
+    assert get_method("customtest") is CustomConfig
+
+
+def test_model_spec_presets():
+    s = ModelSpec.preset("gpt2-xl")
+    assert s.n_layer == 48 and s.d_model == 1600
+    j = ModelSpec.preset("gpt-j-6b")
+    assert j.arch == "gptj" and j.rotary_dim == 64 and not j.tie_lm_head
+    assert ModelSpec(d_model=64, n_head=4).d_ff == 256
+    with pytest.raises(ValueError):
+        ModelSpec(d_model=10, n_head=3)
+
+
+def test_tpu_extra_fields_defaults():
+    cfg = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": "x",
+                "tokenizer_path": "x",
+                "model_type": "t",
+                "model_spec": {"n_layer": 2, "d_model": 64, "n_head": 4},
+            },
+            "train": {
+                "n_ctx": 64,
+                "epochs": 1,
+                "total_steps": 10,
+                "batch_size": 4,
+                "grad_clip": 1.0,
+                "lr_ramp_steps": 1,
+                "lr_decay_steps": 9,
+                "weight_decay": 0.0,
+                "learning_rate_init": 1e-4,
+                "learning_rate_target": 1e-5,
+                "log_interval": 1,
+                "checkpoint_interval": 100,
+                "eval_interval": 10,
+                "pipeline": "PPOPipeline",
+                "orchestrator": "PPOOrchestrator",
+                "mesh": {"dp": -1, "tp": 1},
+            },
+            "method": {"name": "ppoconfig"},
+        }
+    )
+    assert cfg.train.mesh == {"dp": -1, "tp": 1}
+    assert cfg.model.model_spec["n_layer"] == 2
+    spec = ModelSpec.from_dict(cfg.model.model_spec)
+    assert spec.head_dim == 16

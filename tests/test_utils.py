@@ -1,0 +1,47 @@
+"""Utility-floor tests: chunk/flatten/topk_mask/Clock/schedules."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.utils import (
+    Clock,
+    chunk,
+    cosine_schedule,
+    flatten,
+    rampup_decay_schedule,
+    topk_mask,
+)
+
+
+def test_flatten_chunk_roundtrip():
+    xs = list(range(10))
+    assert flatten(chunk(xs, 3)) == xs
+    assert [len(c) for c in chunk(xs, 3)] == [3, 3, 3, 1]
+
+
+def test_topk_mask():
+    x = jnp.array([[1.0, 5.0, 3.0, 2.0]])
+    out = topk_mask(x, 2)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.array([[-np.inf, 5.0, 3.0, -np.inf]])
+    )
+
+
+def test_rampup_decay_schedule():
+    sched = rampup_decay_schedule(10, 90, 1e-3, 1e-5)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(100)), 1e-5, rtol=1e-3)
+
+
+def test_cosine_schedule():
+    sched = cosine_schedule(1e-4, 100)
+    np.testing.assert_allclose(float(sched(0)), 1e-4, rtol=1e-6)
+    assert float(sched(100)) < 1e-6
+
+
+def test_clock():
+    c = Clock()
+    c.tick(100)
+    assert c.total_samples == 100
+    assert c.samples_per_second() > 0

@@ -1,0 +1,25 @@
+"""trlx_tpu — a TPU-native (JAX/XLA/pjit/Pallas) RLHF framework.
+
+Re-implements the capabilities of trlX (reference: `/root/reference`, CarperAI
+trlx v1.0.0 snapshot) with a TPU-first architecture:
+
+- Functional core: params / optimizer state are pytrees, one jitted train step,
+  one jitted decode loop. Python objects only orchestrate.
+- SPMD over a `jax.sharding.Mesh` with axes (dp, fsdp, tp, sp): data parallel,
+  fully-sharded params (ZeRO-equivalent), tensor parallel, and sequence/context
+  parallel (ring attention) — replacing the reference's Accelerate/NCCL stack
+  (reference: trlx/model/accelerate_base_model.py:52-82).
+- The reference's four-piece contract is preserved: prompt pipeline, rollout
+  store, orchestrator, RL trainer, wired through string registries
+  (reference: trlx/utils/loading.py:8-42) and YAML configs
+  (reference: trlx/data/configs.py:136-149).
+
+Public API mirrors the reference's user surface:
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_model, get_pipeline, get_orchestrator
+"""
+
+__version__ = "0.1.0"
+
+from trlx_tpu.data.configs import TRLConfig  # noqa: F401

@@ -1,0 +1,65 @@
+"""Core data containers.
+
+The reference stores per-sample torch-tensor dataclasses and collates them
+per batch (reference: trlx/data/__init__.py, trlx/data/ppo_types.py). On TPU
+the natural unit is the *stacked batch*: fixed-shape arrays that pass through
+`jit` without re-tracing. Batch containers here are registered as JAX pytrees
+so they flow through `jax.jit` / `pjit` / `lax.scan` directly.
+"""
+
+from dataclasses import dataclass, fields
+from typing import Iterable
+
+import jax
+
+
+def register_batch_pytree(cls):
+    """Register a flat dataclass of arrays as a JAX pytree node."""
+    names = [f.name for f in fields(cls)]
+
+    def flatten(x):
+        return tuple(getattr(x, n) for n in names), None
+
+    def unflatten(_, children):
+        return cls(*children)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+@dataclass
+class GeneralElement:
+    """A single piece of data (parity: reference trlx/data/__init__.py:9)."""
+
+    pass
+
+
+@dataclass
+class RLElement:
+    """A single state-action-reward triple (parity: reference
+    trlx/data/__init__.py:29)."""
+
+    state: str = ""
+    action: str = ""
+    reward: float = 0.0
+
+
+def batch_count(batch) -> int:
+    """Leading-axis size of the first array field of a batch container."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return 0
+    return int(leaves[0].shape[0])
+
+
+def concat_batches(batches: Iterable):
+    """Concatenate batch containers along the leading axis (the container
+    type is preserved by the pytree registration)."""
+    import numpy as np
+
+    batches = list(batches)
+    if not batches:
+        raise ValueError("no batches to concatenate")
+    return jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *batches
+    )

@@ -1,0 +1,188 @@
+"""Top-level YAML → typed dataclass configuration.
+
+Keeps the reference's three-section layout and field names
+(model / train / method — reference: trlx/data/configs.py:10-158) so its
+shipped YAMLs parse unchanged, and adds TPU-native fields with defaults:
+mesh axis sizes, dtypes, and from-config model architecture specs (used when
+no pretrained checkpoint is reachable).
+"""
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
+
+import yaml
+
+from trlx_tpu.data.method_configs import (
+    MethodConfig,
+    filter_known_fields as _filter_known,
+    get_method,
+)
+
+
+@dataclass
+class ModelSpec:
+    """Architecture hyperparameters for building a model from config.
+
+    Used both for from-scratch tiny models (the reference builds one in
+    examples/ilql_randomwalks.py:98-100 via GPT2Config) and as the shape
+    contract when importing pretrained HF weights.
+    """
+
+    arch: str = "gpt2"  # gpt2 | gptj | gptneox
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 => 4 * d_model
+    n_positions: int = 1024
+    rotary_dim: int = 0  # gptj/gptneox: rotary dims per head (0 => head_dim)
+    layer_norm_epsilon: float = 1e-5
+    tie_lm_head: bool = True  # gpt2 ties lm_head to wte; gptj/neox do not
+
+    def __post_init__(self):
+        if self.d_ff == 0:
+            self.d_ff = 4 * self.d_model
+        if self.d_model % self.n_head != 0:
+            raise ValueError("d_model must be divisible by n_head")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "ModelSpec":
+        return cls(**_filter_known(cls, config))
+
+    # Named presets for the model families the reference exercises
+    # (reference: README.md:14, configs/ppo_config.yml:2, configs/ppo_gptj.yml:2).
+    @classmethod
+    def preset(cls, name: str) -> "ModelSpec":
+        presets = {
+            "gpt2": cls(arch="gpt2", n_layer=12, n_head=12, d_model=768),
+            "gpt2-medium": cls(arch="gpt2", n_layer=24, n_head=16, d_model=1024),
+            "gpt2-large": cls(arch="gpt2", n_layer=36, n_head=20, d_model=1280),
+            "gpt2-xl": cls(arch="gpt2", n_layer=48, n_head=25, d_model=1600),
+            "gpt-j-6b": cls(
+                arch="gptj",
+                vocab_size=50400,
+                n_layer=28,
+                n_head=16,
+                d_model=4096,
+                n_positions=2048,
+                rotary_dim=64,
+                tie_lm_head=False,
+            ),
+        }
+        key = name.lower()
+        if key not in presets:
+            raise KeyError(f"Unknown model preset '{name}'; known: {sorted(presets)}")
+        return presets[key]
+
+
+@dataclass
+class ModelConfig:
+    """Model section (field parity: reference trlx/data/configs.py:27-31).
+
+    `device` is accepted for YAML compatibility and ignored — placement on
+    TPU is controlled by the mesh (see TrainConfig.mesh).
+
+    TPU extras:
+    :param model_arch: architecture family when building/importing
+    :param model_spec: dict of ModelSpec overrides for from-config models
+    :param param_dtype: dtype parameters are stored in
+    :param compute_dtype: dtype matmuls/activations run in (bf16 for MXU)
+    """
+
+    model_path: str
+    tokenizer_path: str
+    model_type: str
+    device: str = ""
+    num_layers_unfrozen: int = -1
+    model_arch: str = "gpt2"
+    model_spec: Optional[dict] = None
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**_filter_known(cls, config))
+
+
+@dataclass
+class TrainConfig:
+    """Train section (field parity: reference trlx/data/configs.py:94-119).
+
+    `accelerate` / `accelerate_config_path` are accepted for YAML
+    compatibility and ignored; distribution is expressed by `mesh`.
+
+    TPU extras:
+    :param mesh: axis sizes, e.g. {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1};
+        -1 means "all remaining devices"
+    :param seed: global PRNG seed (JAX is explicit about randomness)
+    :param remat: rematerialize transformer blocks in the backward pass
+    """
+
+    n_ctx: int
+    epochs: int
+    total_steps: int
+    batch_size: int
+    grad_clip: float
+
+    lr_ramp_steps: int
+    lr_decay_steps: int
+    weight_decay: float
+    learning_rate_init: float
+    learning_rate_target: float
+
+    log_interval: int
+    checkpoint_interval: int
+    eval_interval: int
+
+    pipeline: str
+    orchestrator: str
+
+    input_size: int = 0
+    gen_size: int = 1024
+
+    accelerate: bool = True
+    accelerate_config_path: str = ""
+
+    project_name: str = ""
+
+    mesh: Optional[Dict[str, int]] = None
+    seed: int = 0
+    remat: bool = False
+    checkpoint_dir: str = "ckpts"
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**_filter_known(cls, config))
+
+
+@dataclass
+class TRLConfig:
+    """Top-level config (reference: trlx/data/configs.py:126-158)."""
+
+    model: ModelConfig
+    train: TrainConfig
+    method: MethodConfig
+
+    @classmethod
+    def load_yaml(cls, yml_fp: str) -> "TRLConfig":
+        with open(yml_fp, mode="r") as f:
+            config = yaml.safe_load(f)
+        return cls.from_dict(config)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "TRLConfig":
+        return cls(
+            ModelConfig.from_dict(config["model"]),
+            TrainConfig.from_dict(config["train"]),
+            get_method(config["method"]["name"]).from_dict(config["method"]),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        data = dict(self.model.__dict__)
+        data.update(self.train.__dict__)
+        data.update(self.method.__dict__)
+        return data

@@ -1,0 +1,65 @@
+"""ILQL offline-sample containers.
+
+Parity target: reference trlx/data/ilql_types.py:10-44 (ILQLElement /
+ILQLBatch): token ids, attention mask, per-token rewards. Batch form is
+stacked fixed-shape arrays (right-padded, like the reference's
+`pad_sequence(batch_first=True)` collate — reference:
+trlx/pipeline/offline_pipeline.py:46-59).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from trlx_tpu.data import register_batch_pytree
+
+
+@dataclass
+class ILQLElement:
+    """One offline sample.
+
+    :param input_ids: token ids, [length]
+    :param attention_mask: 1 for real tokens, 0 for padding, [length]
+    :param rewards: per-token rewards (terminal return on last real slot),
+        [length]
+    """
+
+    input_ids: np.ndarray
+    attention_mask: np.ndarray
+    rewards: np.ndarray
+
+
+@register_batch_pytree
+@dataclass
+class ILQLBatch:
+    """A stacked batch of offline samples.
+
+    :param input_ids: [batch, length]
+    :param attention_mask: [batch, length]
+    :param rewards: [batch, length]
+    """
+
+    input_ids: np.ndarray
+    attention_mask: np.ndarray
+    rewards: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.input_ids.shape[0])
+
+    @classmethod
+    def stack(cls, elements, pad_token_id: int = 0) -> "ILQLBatch":
+        maxlen = max(len(e.input_ids) for e in elements)
+
+        def pad(x, fill):
+            out = np.full((len(elements), maxlen), fill, dtype=np.asarray(x[0]).dtype)
+            for i, row in enumerate(x):
+                out[i, : len(row)] = row
+            return out
+
+        return cls(
+            input_ids=pad([e.input_ids for e in elements], pad_token_id),
+            attention_mask=pad(
+                [e.attention_mask for e in elements], 0
+            ),
+            rewards=pad([e.rewards for e in elements], 0.0).astype(np.float32),
+        )

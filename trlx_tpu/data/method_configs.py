@@ -1,0 +1,111 @@
+"""Per-algorithm hyperparameter configs, looked up by name from YAML.
+
+Mirrors the reference registry contract (reference:
+trlx/data/method_configs.py:8-41) — string-keyed, case-insensitive, with
+`register_method` as decorator. Field sets of `PPOConfig` / `ILQLConfig` are
+kept verbatim (reference: trlx/data/method_configs.py:62-87) so the
+reference's YAML files load unchanged.
+"""
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Callable, Dict
+
+from trlx_tpu.utils.registry import make_register
+
+# Registry of method-config classes by lowercase name.
+_METHODS: Dict[str, type] = {}
+
+#: Decorator registering a method config class under a string name.
+register_method = make_register(_METHODS)
+
+
+def get_method(name: str) -> Callable:
+    """Return the config class registered under `name`."""
+    key = name.lower()
+    if key not in _METHODS:
+        raise KeyError(
+            f"Method config '{name}' is not registered. "
+            f"Known methods: {sorted(_METHODS)}"
+        )
+    return _METHODS[key]
+
+
+def filter_known_fields(cls, config: Dict[str, Any]) -> Dict[str, Any]:
+    """Keep only keys that are dataclass fields of `cls` (tolerates legacy
+    YAML keys like `device` / `accelerate`)."""
+    known = {f.name for f in fields(cls)}
+    return {k: v for k, v in config.items() if k in known}
+
+
+_filter_known = filter_known_fields
+
+
+@dataclass
+@register_method
+class MethodConfig:
+    """Base config for an RL method; `name` selects the registry entry."""
+
+    name: str
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**_filter_known(cls, config))
+
+
+@dataclass
+@register_method
+class PPOConfig(MethodConfig):
+    """PPO hyperparameters (field parity: reference method_configs.py:62-75).
+
+    :param ppo_epochs: optimization epochs over each rollout batch
+    :param num_rollouts: rollouts collected per outer epoch
+    :param chunk_size: rollouts generated per orchestrator loop iteration
+    :param init_kl_coef: initial KL penalty coefficient
+    :param target: target KL for the adaptive controller (None => fixed)
+    :param horizon: adaptive-KL horizon
+    :param gamma: discount
+    :param lam: GAE lambda
+    :param cliprange: policy ratio clip
+    :param cliprange_value: value clip
+    :param vf_coef: value-loss weight
+    :param gen_kwargs: generation settings (max_length/min_length/top_k/top_p/
+        do_sample, plus TPU extras like temperature)
+    """
+
+    ppo_epochs: int = 4
+    num_rollouts: int = 128
+    chunk_size: int = 128
+    init_kl_coef: float = 0.2
+    target: float = 6.0
+    horizon: int = 10000
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 1.0
+    gen_kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+@register_method
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (field parity: reference method_configs.py:79-87).
+
+    :param tau: expectile for the V loss
+    :param gamma: discount
+    :param cql_scale: CQL (cross-entropy on Q) loss weight
+    :param awac_scale: AWAC (LM cross-entropy) loss weight
+    :param alpha: Polyak coefficient for target-Q sync
+    :param steps_for_target_q_sync: sync period in optimizer steps
+    :param beta: advantage temperature used at sampling time
+    :param two_qs: use min(Q1, Q2) double-Q
+    """
+
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.005
+    steps_for_target_q_sync: int = 1
+    beta: float = 4.0
+    two_qs: bool = True

@@ -1,0 +1,83 @@
+"""PPO rollout containers.
+
+Parity target: reference trlx/data/ppo_types.py:9-58 (PPORLElement /
+PPORLBatch). Differences, deliberately:
+
+- `logprobs` are gathered per-token logprobs of shape [response_size] — the
+  reference's docstring claims vocab-sized logprobs but its orchestrator
+  stores gathered ones (reference: trlx/orchestrator/ppo_orchestrator.py:78);
+  we document the actual contract.
+- The batch form is the primary citizen (stacked, fixed-shape arrays) so it
+  is jit/pjit-transparent; the element form exists for API familiarity.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from trlx_tpu.data import register_batch_pytree
+
+
+@dataclass
+class PPORLElement:
+    """One rollout record.
+
+    :param query_tensor: prompt tokens, [query_size]
+    :param response_tensor: generated tokens, [response_size]
+    :param logprobs: per-token logprobs of the response under the policy at
+        rollout time, [response_size]
+    :param values: value-head outputs aligned with response tokens,
+        [response_size]
+    :param rewards: per-token rewards (KL penalty everywhere, score added on
+        the final token), [response_size]
+    """
+
+    query_tensor: np.ndarray
+    response_tensor: np.ndarray
+    logprobs: np.ndarray
+    values: np.ndarray
+    rewards: np.ndarray
+
+
+@register_batch_pytree
+@dataclass
+class PPORLBatch:
+    """A stacked batch of rollouts.
+
+    :param query_tensors: [batch, query_size]
+    :param response_tensors: [batch, response_size]
+    :param logprobs: [batch, response_size]
+    :param values: [batch, response_size]
+    :param rewards: [batch, response_size]
+    """
+
+    query_tensors: np.ndarray
+    response_tensors: np.ndarray
+    logprobs: np.ndarray
+    values: np.ndarray
+    rewards: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.query_tensors.shape[0])
+
+    @classmethod
+    def stack(cls, elements) -> "PPORLBatch":
+        return cls(
+            query_tensors=np.stack([e.query_tensor for e in elements]),
+            response_tensors=np.stack([e.response_tensor for e in elements]),
+            logprobs=np.stack([e.logprobs for e in elements]),
+            values=np.stack([e.values for e in elements]),
+            rewards=np.stack([e.rewards for e in elements]),
+        )
+
+    def unstack(self):
+        return [
+            PPORLElement(
+                self.query_tensors[i],
+                self.response_tensors[i],
+                self.logprobs[i],
+                self.values[i],
+                self.rewards[i],
+            )
+            for i in range(len(self))
+        ]

@@ -1,0 +1,34 @@
+"""Orchestrator base + registry.
+
+Parity target: reference trlx/orchestrator/__init__.py:9-46 (`_ORCH`,
+`register_orchestrator`, `Orchestrator`). An orchestrator binds a pipeline to
+an RL trainer and fills the trainer's rollout store via `make_experience`.
+"""
+
+from abc import abstractmethod
+from typing import Dict
+
+from trlx_tpu.utils.registry import BuiltinLoader, make_register
+
+_ORCH: Dict[str, type] = {}
+_load_builtins = BuiltinLoader(
+    (
+        "trlx_tpu.orchestrator.ppo_orchestrator",
+        "trlx_tpu.orchestrator.offline_orchestrator",
+    )
+)
+
+#: Decorator registering an orchestrator class under a string name.
+register_orchestrator = make_register(_ORCH)
+
+
+class Orchestrator:
+    """Binds (pipeline, rl_trainer); fills the trainer's store."""
+
+    def __init__(self, pipeline, rl_model):
+        self.pipeline = pipeline
+        self.rl_model = rl_model
+
+    @abstractmethod
+    def make_experience(self, num_rollouts: int = 128, iter_count: int = 0):
+        raise NotImplementedError

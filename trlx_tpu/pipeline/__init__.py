@@ -1,0 +1,93 @@
+"""Pipeline / rollout-store bases + registry.
+
+Parity target: reference trlx/pipeline/__init__.py:12-98 (`_DATAPIPELINE`,
+`register_datapipeline`, `BasePipeline`, `BaseRolloutStore`). Loaders here
+yield stacked-array batches (numpy on host) instead of torch DataLoaders —
+the device boundary is crossed once per batch inside the jitted step.
+"""
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterator
+
+import numpy as np
+
+from trlx_tpu.utils.registry import BuiltinLoader, make_register
+
+_DATAPIPELINE: Dict[str, type] = {}
+_load_builtins = BuiltinLoader(
+    ("trlx_tpu.pipeline.ppo_pipeline", "trlx_tpu.pipeline.offline_pipeline")
+)
+
+#: Decorator registering a pipeline class under a string name.
+register_datapipeline = make_register(_DATAPIPELINE)
+
+
+class BasePipeline:
+    """Abstract prompt dataset (parity: reference pipeline/__init__.py:38-63)."""
+
+    def __init__(self, path: str = "dataset"):
+        self.path = path
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+    @abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    @abstractmethod
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> Iterator:
+        """Yield stacked batches of prompts."""
+        raise NotImplementedError
+
+
+class BaseRolloutStore:
+    """Abstract experience store (parity: reference
+    pipeline/__init__.py:66-98). Unlike the reference, `capacity` is actually
+    enforced (the reference declares but never uses it)."""
+
+    def __init__(self, capacity: int = -1):
+        self.capacity = capacity
+        self.history: Any = None
+
+    @abstractmethod
+    def push(self, exps) -> None:
+        raise NotImplementedError
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+    @abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    @abstractmethod
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, seed: int = 0
+    ) -> Iterator:
+        raise NotImplementedError
+
+
+def batch_iterator(
+    n: int,
+    batch_size: int,
+    shuffle: bool,
+    seed: int,
+    fetch: Callable[[np.ndarray], Any],
+    drop_last: bool = True,
+) -> Iterator:
+    """Shared index-batching loop: yields `fetch(indices)` per batch."""
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    end = n - (n % batch_size) if drop_last else n
+    for start in range(0, end, batch_size):
+        yield fetch(idx[start : start + batch_size])

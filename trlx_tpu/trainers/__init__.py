@@ -1,0 +1,95 @@
+"""RL trainer base + registry.
+
+Parity target: reference trlx/model/__init__.py:14-140 (`_MODELS`,
+`register_model`, `BaseRLModel`). The reference calls trainers "models"; we
+register under both vocabularies. The abstract surface (`act` / `sample` /
+`learn` / `save` / `load` / `intervals` / `push_to_store`) is preserved, but
+state is functional: parameters and optimizer state are pytrees held by the
+trainer, stepped by jitted pure functions.
+"""
+
+from abc import abstractmethod
+from typing import Callable, Dict
+
+from trlx_tpu.utils.registry import BuiltinLoader, make_register
+
+_TRAINERS: Dict[str, type] = {}
+_load_builtins = BuiltinLoader(
+    ("trlx_tpu.trainers.ppo_trainer", "trlx_tpu.trainers.ilql_trainer")
+)
+
+#: Decorator registering a trainer class under a string name.
+register_trainer = make_register(_TRAINERS)
+
+
+# Reference-compatible alias (reference: trlx/model/__init__.py:17).
+register_model = register_trainer
+
+
+class BaseRLTrainer:
+    """Abstract RL trainer (parity: reference trlx/model/__init__.py:40-140).
+
+    Subclasses own: tokenizer, model params (pytrees), optimizer state, the
+    rollout/train store, and jitted step functions.
+    """
+
+    def __init__(self, config, train_mode: bool = True):
+        self.config = config
+        self.train_mode = train_mode
+        self.store = None
+
+    def push_to_store(self, data) -> None:
+        """Append experience to the rollout store
+        (parity: reference model/__init__.py:46)."""
+        self.store.push(data)
+
+    @abstractmethod
+    def act(self, prompts):
+        """Generate responses for a batch of prompts; returns (query_tokens,
+        response_tokens, response_texts)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def sample(self, prompts, length: int, n_samples: int):
+        """Sample continuations from the current policy."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def learn(self, log_fn: Callable = None, save_fn: Callable = None,
+              eval_fn: Callable = None):
+        """Run the optimization loop over the store."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def get_components(self) -> Dict:
+        """Named checkpointable components
+        (parity: reference model/__init__.py:90-99)."""
+        raise NotImplementedError
+
+    def save(self, directory: str = None) -> None:
+        """Checkpoint components (reference's torch.save per component →
+        Orbax here; see trlx_tpu.utils.checkpoint)."""
+        from trlx_tpu.utils.checkpoint import save_components
+
+        save_components(self.get_components(), directory or self.config.train.checkpoint_dir)
+
+    def load(self, directory: str = None) -> None:
+        from trlx_tpu.utils.checkpoint import restore_components
+
+        restored = restore_components(
+            self.get_components(), directory or self.config.train.checkpoint_dir
+        )
+        self.set_components(restored)
+
+    def set_components(self, components: Dict) -> None:
+        raise NotImplementedError
+
+    def intervals(self, steps: int) -> Dict[str, bool]:
+        """Which periodic actions fire at `steps`
+        (parity: reference model/__init__.py:131-140)."""
+        return {
+            "do_log": steps % self.config.train.log_interval == 0,
+            "do_eval": steps % self.config.train.eval_interval == 0,
+            "do_save": steps > 0
+            and steps % self.config.train.checkpoint_interval == 0,
+        }

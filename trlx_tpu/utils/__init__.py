@@ -1,0 +1,124 @@
+"""General utilities.
+
+Parity targets: reference trlx/utils/__init__.py:12-116 (`flatten`, `chunk`,
+`rampup_decay`, `safe_mkdir`, `Clock`, `topk_mask`) and
+trlx/utils/modeling.py:5-29 (`whiten`, `clip_by_value`,
+`logprobs_from_logits`) — the math lives in trlx_tpu.ops; schedules are
+optax-native here.
+"""
+
+import os
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def flatten(xs: Iterable[Iterable[Any]]) -> List[Any]:
+    """Flatten one level of nesting (parity: reference utils/__init__.py:12)."""
+    return [item for sub in xs for item in sub]
+
+
+def chunk(xs: List[Any], chunk_size: int) -> List[List[Any]]:
+    """Split a list into chunks of at most `chunk_size`
+    (parity: reference utils/__init__.py:19)."""
+    return [xs[i : i + chunk_size] for i in range(0, len(xs), chunk_size)]
+
+
+def safe_mkdir(path: str) -> None:
+    """mkdir -p (parity: reference utils/__init__.py:38)."""
+    os.makedirs(path, exist_ok=True)
+
+
+def rampup_decay_schedule(
+    ramp_steps: int,
+    decay_steps: int,
+    lr_init: float,
+    lr_target: float,
+) -> optax.Schedule:
+    """Linear warmup to `lr_init`, then linear decay to `lr_target`.
+
+    The optax-native replacement for the reference's chained-LinearLR
+    `rampup_decay` (reference: trlx/utils/__init__.py:29-36).
+    """
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, lr_init, max(ramp_steps, 1)),
+            optax.linear_schedule(lr_init, lr_target, max(decay_steps, 1)),
+        ],
+        boundaries=[max(ramp_steps, 1)],
+    )
+
+
+def cosine_schedule(lr_init: float, total_steps: int, lr_min: float = 1e-9) -> optax.Schedule:
+    """Cosine annealing from `lr_init` (the PPO trainer's schedule; reference:
+    trlx/model/accelerate_base_model.py:66-70 uses CosineAnnealingLR)."""
+    return optax.cosine_decay_schedule(
+        lr_init, max(total_steps, 1), alpha=lr_min / max(lr_init, 1e-30)
+    )
+
+
+def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the top-k entries of the last axis, set the rest to -inf
+    (parity: reference utils/__init__.py:94-103)."""
+    kth = jnp.sort(xs, axis=-1)[..., -k][..., None]
+    return jnp.where(xs < kth, -jnp.inf, xs)
+
+
+class Clock:
+    """Wall-time / throughput helper (parity: reference
+    utils/__init__.py:50-88).
+
+    `tick(samples)` records a timing mark; `get_stat("time/...", n)` reports
+    average seconds per `n` samples since the last reset.
+    """
+
+    def __init__(self, window: int = 1000):
+        self.start = time.time()
+        self.total_seconds = 0.0
+        self.total_samples = 0
+        self._marks = deque(maxlen=window)
+
+    def tick(self, samples: int = 0) -> float:
+        """Returns seconds since last tick. Elapsed time only counts toward
+        throughput when samples were processed, so a bare `tick()` acts as a
+        timing mark that excludes idle/setup time (matching the reference's
+        semantics, trlx/utils/__init__.py:66-72)."""
+        now = time.time()
+        delta = now - self.start
+        self.start = now
+        if samples:
+            self.total_seconds += delta
+            self.total_samples += samples
+            self._marks.append((delta, samples))
+        return delta
+
+    def get_stat(self, n_samp: int = 1000, reset: bool = False) -> float:
+        """Average seconds per `n_samp` samples."""
+        sec_per_samp = self.total_seconds / max(self.total_samples, 1)
+        if reset:
+            self.total_seconds = 0.0
+            self.total_samples = 0
+        return sec_per_samp * n_samp
+
+    def samples_per_second(self) -> float:
+        return self.total_samples / max(self.total_seconds, 1e-9)
+
+
+def to_np(tree):
+    """Device→host a pytree of jax arrays as numpy."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def significant(x: float, ndigits: int = 4) -> float:
+    """Round to significant digits for metric logging."""
+    if x == 0 or not np.isfinite(x):
+        return x
+    return float(np.format_float_positional(
+        x, precision=ndigits, unique=False, fractional=False, trim="k"
+    ))

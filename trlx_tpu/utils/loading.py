@@ -1,0 +1,44 @@
+"""Name → class lookup for trainers / pipelines / orchestrators.
+
+The plugin boundary (parity: reference trlx/utils/loading.py:8-42). Importing
+this module imports the built-in implementations so their `@register_*`
+decorators run, exactly as the reference does.
+"""
+
+
+def get_model(name: str):
+    """Return the trainer class registered under `name`
+    (the reference calls trainers "models")."""
+    from trlx_tpu.trainers import _TRAINERS, _load_builtins
+
+    _load_builtins()
+    key = name.lower()
+    if key in _TRAINERS:
+        return _TRAINERS[key]
+    raise KeyError(f"Model/trainer '{name}' not registered; known: {sorted(_TRAINERS)}")
+
+
+# Alias with the more accurate name.
+get_trainer = get_model
+
+
+def get_pipeline(name: str):
+    """Return the pipeline class registered under `name`."""
+    from trlx_tpu.pipeline import _DATAPIPELINE, _load_builtins
+
+    _load_builtins()
+    key = name.lower()
+    if key in _DATAPIPELINE:
+        return _DATAPIPELINE[key]
+    raise KeyError(f"Pipeline '{name}' not registered; known: {sorted(_DATAPIPELINE)}")
+
+
+def get_orchestrator(name: str):
+    """Return the orchestrator class registered under `name`."""
+    from trlx_tpu.orchestrator import _ORCH, _load_builtins
+
+    _load_builtins()
+    key = name.lower()
+    if key in _ORCH:
+        return _ORCH[key]
+    raise KeyError(f"Orchestrator '{name}' not registered; known: {sorted(_ORCH)}")

@@ -1,0 +1,49 @@
+"""Shared string-keyed class registry machinery.
+
+One implementation behind the four registries the reference keeps as
+separate copies (`_MODELS`, `_DATAPIPELINE`, `_ORCH`, `_METHODS` — reference:
+trlx/model/__init__.py:14, trlx/pipeline/__init__.py:12,
+trlx/orchestrator/__init__.py:9, trlx/data/method_configs.py:8).
+"""
+
+import importlib
+from typing import Dict, Sequence
+
+
+def make_register(registry: Dict[str, type]):
+    """Build a decorator that registers a class under a lowercase name.
+
+    Usable bare (``@register``) or with an explicit name
+    (``@register("myname")``).
+    """
+
+    def register(name):
+        def register_class(cls, key: str):
+            registry[key.lower()] = cls
+            return cls
+
+        if isinstance(name, str):
+            return lambda cls: register_class(cls, name)
+        return register_class(name, name.__name__)
+
+    return register
+
+
+class BuiltinLoader:
+    """Imports builtin implementation modules exactly once, on first lookup.
+
+    The loaded flag is only set after all imports succeed, so a failed import
+    is retried (and re-raised with its real cause) instead of being cached as
+    an empty registry.
+    """
+
+    def __init__(self, modules: Sequence[str]):
+        self.modules = tuple(modules)
+        self.loaded = False
+
+    def __call__(self):
+        if self.loaded:
+            return
+        for mod in self.modules:
+            importlib.import_module(mod)
+        self.loaded = True
