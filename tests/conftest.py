@@ -14,6 +14,13 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 
 import jax  # noqa: E402
 
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+# JAX's DEFAULT matmul precision on CPU downcasts to bf16-like accuracy;
+# correctness tests need true f32 matmuls (on TPU the library passes
+# bf16 compute_dtype explicitly, so this only affects tests).
+jax.config.update("jax_default_matmul_precision", "highest")
+
 import pytest  # noqa: E402
 
 

@@ -19,11 +19,12 @@ from trlx_tpu.data.method_configs import (
 )
 
 
-@dataclass
+@dataclass(frozen=True)
 class ModelSpec:
     """Architecture hyperparameters for building a model from config.
 
-    Used both for from-scratch tiny models (the reference builds one in
+    Frozen (hashable) so jitted functions can be cached per spec. Used both
+    for from-scratch tiny models (the reference builds one in
     examples/ilql_randomwalks.py:98-100 via GPT2Config) and as the shape
     contract when importing pretrained HF weights.
     """
@@ -41,7 +42,7 @@ class ModelSpec:
 
     def __post_init__(self):
         if self.d_ff == 0:
-            self.d_ff = 4 * self.d_model
+            object.__setattr__(self, "d_ff", 4 * self.d_model)
         if self.d_model % self.n_head != 0:
             raise ValueError("d_model must be divisible by n_head")
 
@@ -106,6 +107,14 @@ class ModelConfig:
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         return cls(**_filter_known(cls, config))
+
+    def resolve_spec(self) -> "ModelSpec":
+        """Single source of truth for the architecture spec: `model_spec`
+        overrides, with `model_arch` supplying the arch unless the spec dict
+        sets it explicitly."""
+        overrides = dict(self.model_spec or {})
+        overrides.setdefault("arch", self.model_arch)
+        return ModelSpec.from_dict(overrides)
 
 
 @dataclass

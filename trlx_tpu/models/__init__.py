@@ -1,0 +1,7 @@
+"""Model layer: functional transformer trunk, hydra policy, heads, decode.
+
+Replaces reference L1 (trlx/model/nn/) with pure-functional JAX equivalents.
+"""
+
+from trlx_tpu.models.policy import HydraPolicy  # noqa: F401
+from trlx_tpu.models.transformer import ArchFlags  # noqa: F401

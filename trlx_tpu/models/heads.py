@@ -1,0 +1,34 @@
+"""Auxiliary heads (value, Q) attached to the trunk.
+
+Parity: the reference's `make_head` is Linear(d, 2d) → ReLU → Linear(2d, out)
+(reference: trlx/model/nn/ppo_models.py:32-35, trlx/model/nn/ilql_models.py:23-26).
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_head_params(
+    rng: jax.Array, d_in: int, d_out: int, dtype=jnp.float32
+) -> Params:
+    k1, k2 = jax.random.split(rng)
+    hidden = 2 * d_in
+    lim1 = 1.0 / jnp.sqrt(jnp.float32(d_in))
+    lim2 = 1.0 / jnp.sqrt(jnp.float32(hidden))
+    return {
+        "w1": jax.random.uniform(k1, (d_in, hidden), dtype, -lim1, lim1),
+        "b1": jnp.zeros((hidden,), dtype),
+        "w2": jax.random.uniform(k2, (hidden, d_out), dtype, -lim2, lim2),
+        "b2": jnp.zeros((d_out,), dtype),
+    }
+
+
+def head_apply(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """MLP head; returns float32 for numerically-sensitive downstream losses."""
+    h = jax.nn.relu(x @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
+    out = h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype)
+    return out.astype(jnp.float32)

@@ -1,0 +1,214 @@
+"""Hydra policy: shared frozen trunk, trainable top, frozen reference top,
+value head.
+
+Parity target: `GPTHydraHeadWithValueModel` + `ModelBranch` (reference:
+trlx/model/nn/ppo_models.py:304-350, 113-300). Design difference, deliberate:
+the reference's `forward_hydra` runs the *entire* trained model and then
+re-runs the top layers through deep-copied frozen modules (reference:
+ppo_models.py:340-347 — its own docs call this wasteful). Here the split is
+structural: params are partitioned into
+
+- ``frozen_base``: embeddings + bottom ``L - k`` blocks (never updated),
+- ``trainable``:  top ``k`` blocks + ln_f + value head (+ lm head if untied),
+- ``ref``:        an init-time copy of the trainable transformer part,
+
+and one forward computes trunk **once**, then branches twice — policy logits
++ values and reference logits in a single pass. Gradients are taken w.r.t.
+``trainable`` only, which also subsumes the reference's separate
+bottom-layer freezing loop (reference: trlx/model/accelerate_base_model.py:38-41).
+
+``num_layers_unfrozen`` semantics (one definition, unlike the reference's
+inconsistent uses — see SURVEY §"quirks"): k = num_layers_unfrozen top
+blocks are trainable; -1 means all blocks trainable (ref branch is then a
+full-depth copy, matching the reference's full-model CPU copy at
+trlx/orchestrator/ppo_orchestrator.py:38-39, but kept on-device and sharded).
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelSpec
+from trlx_tpu.models.heads import head_apply, init_head_params
+from trlx_tpu.models.transformer import (
+    apply_blocks,
+    attention_scores,
+    causal_mask_bias,
+    embed_tokens,
+    init_block_params,
+    init_embed_params,
+    init_ln_f_params,
+    layer_norm,
+    positions_from_mask,
+    project_logits,
+)
+
+Params = Dict[str, Any]
+
+
+def resolve_num_unfrozen(spec: ModelSpec, num_layers_unfrozen: int) -> int:
+    if num_layers_unfrozen < 0:
+        return spec.n_layer
+    return min(num_layers_unfrozen, spec.n_layer)
+
+
+@dataclass(frozen=True)
+class HydraPolicy:
+    """Static description of a hydra policy; all methods are pure functions
+    over the params pytree and safe to close over in `jit`."""
+
+    spec: ModelSpec
+    num_layers_unfrozen: int = -1
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Any = None  # None => plain XLA attention
+
+    @property
+    def k(self) -> int:
+        return resolve_num_unfrozen(self.spec, self.num_layers_unfrozen)
+
+    def _attn(self):
+        return self.attention_fn or attention_scores
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        """Jitted init: one compiled program instead of hundreds of eager
+        dispatches (eager-op overhead dominates otherwise)."""
+        return _jitted_init(self, param_dtype)(rng)
+
+    def jit_forward(self, with_ref: bool = True):
+        """A cached, jitted forward(params, tokens, attention_mask)."""
+        return _jitted_forward(self, with_ref)
+
+    def _init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        spec, k = self.spec, self.k
+        k_embed, k_blocks, k_head = jax.random.split(rng, 3)
+        embed = init_embed_params(k_embed, spec, param_dtype)
+        blocks = init_block_params(k_blocks, spec, spec.n_layer, param_dtype)
+        bottom = jax.tree_util.tree_map(lambda x: x[: spec.n_layer - k], blocks)
+        top = jax.tree_util.tree_map(lambda x: x[spec.n_layer - k :], blocks)
+        ln_f = init_ln_f_params(spec, param_dtype)
+
+        lm_head = embed.pop("lm_head", None)
+        trainable: Params = {
+            "blocks": top,
+            "ln_f": ln_f,
+            "v_head": init_head_params(k_head, spec.d_model, 1, param_dtype),
+        }
+        ref: Params = {
+            "blocks": jax.tree_util.tree_map(jnp.copy, top),
+            "ln_f": jax.tree_util.tree_map(jnp.copy, ln_f),
+        }
+        if lm_head is not None:
+            trainable["lm_head"] = lm_head
+            ref["lm_head"] = jax.tree_util.tree_map(jnp.copy, lm_head)
+        return {
+            "frozen_base": {"embed": embed, "blocks": bottom},
+            "trainable": trainable,
+            "ref": ref,
+        }
+
+    # -- forward ------------------------------------------------------------
+
+    def _trunk(self, params: Params, tokens, attention_mask):
+        positions = positions_from_mask(attention_mask)
+        mask_bias = causal_mask_bias(attention_mask)
+        h = embed_tokens(
+            params["frozen_base"]["embed"],
+            self.spec,
+            tokens,
+            positions,
+            self.compute_dtype,
+        )
+        h = apply_blocks(
+            params["frozen_base"]["blocks"],
+            self.spec,
+            h,
+            mask_bias,
+            positions,
+            remat=self.remat,
+            attention_fn=self._attn(),
+        )
+        return h, mask_bias, positions
+
+    def _branch_logits(
+        self, branch: Params, embed: Params, h, mask_bias, positions
+    ):
+        """Run a top branch; returns (logits, post-ln_f hidden). The value
+        head reads the post-ln_f hidden, matching the reference's v_head on
+        the transformer output (reference: ppo_models.py:62-104)."""
+        h = apply_blocks(
+            branch["blocks"],
+            self.spec,
+            h,
+            mask_bias,
+            positions,
+            remat=self.remat,
+            attention_fn=self._attn(),
+        )
+        h_normed = layer_norm(branch["ln_f"], h, self.spec.layer_norm_epsilon)
+        head_params = dict(embed)
+        if "lm_head" in branch:
+            head_params["lm_head"] = branch["lm_head"]
+        return project_logits(head_params, self.spec, h_normed), h_normed
+
+    def forward(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+        with_ref: bool = True,
+    ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], jnp.ndarray]:
+        """Returns (logits, ref_logits | None, values).
+
+        logits/ref_logits: [B, T, V] float32; values: [B, T] float32.
+        The trunk (embeddings + frozen bottom blocks) runs exactly once.
+        """
+        h, mask_bias, positions = self._trunk(params, tokens, attention_mask)
+        embed = params["frozen_base"]["embed"]
+        logits, h_top = self._branch_logits(
+            params["trainable"], embed, h, mask_bias, positions
+        )
+        values = head_apply(params["trainable"]["v_head"], h_top).squeeze(-1)
+        ref_logits = None
+        if with_ref:
+            ref_in = jax.lax.stop_gradient(h)
+            ref_logits, _ = self._branch_logits(
+                params["ref"], embed, ref_in, mask_bias, positions
+            )
+            ref_logits = jax.lax.stop_gradient(ref_logits)
+        return logits, ref_logits, values
+
+    # -- decode support -----------------------------------------------------
+
+    def all_blocks(self, params: Params) -> Params:
+        """Bottom + trainable top stacked into one [L, ...] tree — the live
+        policy the decode engine runs."""
+        bottom = params["frozen_base"]["blocks"]
+        top = params["trainable"]["blocks"]
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), bottom, top
+        )
+
+    def head_params_for_decode(self, params: Params) -> Tuple[Params, Params]:
+        """(embed+lm_head dict, ln_f) for the live policy branch."""
+        embed = dict(params["frozen_base"]["embed"])
+        if "lm_head" in params["trainable"]:
+            embed["lm_head"] = params["trainable"]["lm_head"]
+        return embed, params["trainable"]["ln_f"]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_init(policy: HydraPolicy, param_dtype):
+    return jax.jit(lambda rng: policy._init(rng, param_dtype))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_forward(policy: HydraPolicy, with_ref: bool):
+    return jax.jit(
+        lambda params, tokens, mask: policy.forward(params, tokens, mask, with_ref)
+    )

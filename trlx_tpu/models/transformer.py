@@ -1,0 +1,393 @@
+"""Functional GPT-family transformer trunk.
+
+TPU-first design, replacing the reference's HF torch modules (reference:
+trlx/model/nn/ppo_models.py:41-300 wraps transformers GPT2/GPT-J):
+
+- Parameters are plain pytrees. Per-layer tensors are **stacked along a
+  leading layer axis** and the trunk runs as one `lax.scan` over layers —
+  one compiled block body regardless of depth (fast compiles), natural
+  slicing for the hydra frozen-branch split, and clean partition specs.
+- Compute runs in `compute_dtype` (bfloat16 for the MXU); layernorm and
+  softmax accumulate in float32.
+- No data-dependent Python control flow: masks/positions are computed with
+  array ops, padding is handled with additive mask bias, positions derive
+  from the attention mask (left-padding safe).
+
+Architecture variants (selected by ModelSpec.arch):
+- "gpt2": learned positions, sequential pre-LN block, biased projections,
+  tied lm head.
+- "gptj": rotary (partial, `rotary_dim`), parallel attn+MLP block sharing
+  one layernorm, unbiased attention projections, untied head.
+- "gptneox": rotary, parallel residual with separate MLP layernorm, biased
+  projections, untied head.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelSpec
+
+Params = Dict[str, Any]
+
+NEG_INF = -1e9  # additive mask value; avoids -inf NaN propagation in softmax
+
+
+@dataclass(frozen=True)
+class ArchFlags:
+    """Derived per-arch structural switches."""
+
+    parallel_block: bool
+    use_rotary: bool
+    attn_bias: bool
+    separate_mlp_ln: bool  # gpt2/neox: ln_2 feeds the MLP; gptj: shared ln_1
+    rotary_interleaved: bool = False  # gptj rotates every-two; neox rotates halves
+
+    @classmethod
+    def for_spec(cls, spec: ModelSpec) -> "ArchFlags":
+        arch = spec.arch.lower()
+        if arch == "gpt2":
+            return cls(False, False, True, True)
+        if arch == "gptj":
+            return cls(True, True, False, False, rotary_interleaved=True)
+        if arch == "gptneox":
+            return cls(True, True, True, True)
+        raise ValueError(f"unknown arch '{spec.arch}'")
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(rng, shape, dtype, scale=0.02):
+    return (scale * jax.random.normal(rng, shape)).astype(dtype)
+
+
+def init_block_params(
+    rng: jax.Array, spec: ModelSpec, n_layers: int, dtype=jnp.float32
+) -> Params:
+    """Stacked parameters for `n_layers` transformer blocks: every leaf has
+    leading axis `n_layers`."""
+    flags = ArchFlags.for_spec(spec)
+    d, f = spec.d_model, spec.d_ff
+    keys = jax.random.split(rng, 8)
+    # GPT-2 residual scaling: two residual additions per block.
+    resid_scale = 0.02 / max(2 * spec.n_layer, 1) ** 0.5
+
+    def stack(initer, *shape_key):
+        shape, key = shape_key
+        return jnp.stack([initer(k, shape) for k in jax.random.split(key, n_layers)])
+
+    blocks: Params = {
+        "ln_1": {
+            "scale": jnp.ones((n_layers, d), dtype),
+            "bias": jnp.zeros((n_layers, d), dtype),
+        },
+        "attn": {
+            "wq": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[0]),
+            "wk": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[1]),
+            "wv": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[2]),
+            "wo": stack(
+                lambda k, s: _dense_init(k, s, dtype, resid_scale), (d, d), keys[3]
+            ),
+        },
+        "mlp": {
+            "w_in": stack(lambda k, s: _dense_init(k, s, dtype), (d, f), keys[4]),
+            "b_in": jnp.zeros((n_layers, f), dtype),
+            "w_out": stack(
+                lambda k, s: _dense_init(k, s, dtype, resid_scale), (f, d), keys[5]
+            ),
+            "b_out": jnp.zeros((n_layers, d), dtype),
+        },
+    }
+    if flags.attn_bias:
+        blocks["attn"]["bq"] = jnp.zeros((n_layers, d), dtype)
+        blocks["attn"]["bk"] = jnp.zeros((n_layers, d), dtype)
+        blocks["attn"]["bv"] = jnp.zeros((n_layers, d), dtype)
+    blocks["attn"]["bo"] = jnp.zeros((n_layers, d), dtype)
+    if flags.separate_mlp_ln:
+        blocks["ln_2"] = {
+            "scale": jnp.ones((n_layers, d), dtype),
+            "bias": jnp.zeros((n_layers, d), dtype),
+        }
+    return blocks
+
+
+def init_embed_params(rng: jax.Array, spec: ModelSpec, dtype=jnp.float32) -> Params:
+    flags = ArchFlags.for_spec(spec)
+    k_wte, k_wpe, k_head = jax.random.split(rng, 3)
+    params: Params = {"wte": _dense_init(k_wte, (spec.vocab_size, spec.d_model), dtype)}
+    if not flags.use_rotary:
+        params["wpe"] = _dense_init(
+            k_wpe, (spec.n_positions, spec.d_model), dtype, scale=0.01
+        )
+    if not spec.tie_lm_head:
+        params["lm_head"] = {
+            "w": _dense_init(k_head, (spec.d_model, spec.vocab_size), dtype),
+            "b": jnp.zeros((spec.vocab_size,), dtype),
+        }
+    return params
+
+
+def init_ln_f_params(spec: ModelSpec, dtype=jnp.float32) -> Params:
+    return {
+        "scale": jnp.ones((spec.d_model,), dtype),
+        "bias": jnp.zeros((spec.d_model,), dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Core ops
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(p: Params, x: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """LayerNorm in float32 regardless of compute dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(
+        dtype
+    )
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def apply_rotary(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    rotary_dim: int,
+    interleaved: bool = False,
+) -> jnp.ndarray:
+    """Rotary position embedding on the first `rotary_dim` dims of each head.
+
+    x: [B, T, H, hd]; positions: [B, T]. `interleaved=True` is the GPT-J
+    rotate-every-two convention; False is the GPT-NeoX half-rotation.
+    """
+    hd = x.shape[-1]
+    rot_dim = rotary_dim if rotary_dim > 0 else hd
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    inv_freq = 1.0 / (
+        10000.0 ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim)
+    )
+    # [B, T, rot_dim/2]
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq
+    if interleaved:
+        # each frequency repeated twice, interleaved: [f0, f0, f1, f1, ...]
+        emb = jnp.repeat(freqs, 2, axis=-1)[:, :, None, :]
+        rotate = _rotate_every_two
+    else:
+        emb = jnp.concatenate([freqs, freqs], axis=-1)[:, :, None, :]
+        rotate = _rotate_half
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+    x32 = x_rot.astype(jnp.float32)
+    out = x32 * cos + rotate(x32) * sin
+    return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
+
+
+def attention_scores(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask_bias: jnp.ndarray,
+) -> jnp.ndarray:
+    """Plain attention: softmax in f32, matmuls in input dtype (bf16 on MXU).
+
+    q: [B, Tq, H, hd]; k, v: [B, Tk, H, hd]; mask_bias: [B, 1, Tq, Tk].
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd)) + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _project(x, w, b=None):
+    y = x @ w.astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y
+
+
+def gelu_new(x: jnp.ndarray) -> jnp.ndarray:
+    """The exact tanh-approximation GELU used by GPT-2/GPT-J/NeoX
+    ("gelu_new"); written out so it matches HF bit-for-bit closer than
+    jax.nn.gelu's internal formulation."""
+    x3 = x * x * x
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * (x + 0.044715 * x3)))
+
+
+def block_apply(
+    spec: ModelSpec,
+    flags: ArchFlags,
+    p: Params,
+    h: jnp.ndarray,
+    mask_bias: jnp.ndarray,
+    positions: jnp.ndarray,
+    kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    cache_offset: Optional[jnp.ndarray] = None,
+    attention_fn=attention_scores,
+) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
+    """One transformer block on hidden states `h` [B, T, D].
+
+    When `kv_cache` is given as (k_cache, v_cache) [B, Tbuf, H, hd], fresh
+    keys/values are written into the cache buffer at scalar `cache_offset`
+    (the same buffer slot for every row — sequences are kept aligned in the
+    buffer; per-row *logical* positions for rotary come from `positions`),
+    and attention runs q against the full buffer (decode mode: T is the
+    fresh suffix, typically 1).
+    """
+    B, T, D = h.shape
+    H, hd = spec.n_head, spec.head_dim
+    eps = spec.layer_norm_epsilon
+
+    x = layer_norm(p["ln_1"], h, eps)
+    attn = p["attn"]
+    q = _project(x, attn["wq"], attn.get("bq")).reshape(B, T, H, hd)
+    k = _project(x, attn["wk"], attn.get("bk")).reshape(B, T, H, hd)
+    v = _project(x, attn["wv"], attn.get("bv")).reshape(B, T, H, hd)
+    if flags.use_rotary:
+        q = apply_rotary(q, positions, spec.rotary_dim, flags.rotary_interleaved)
+        k = apply_rotary(k, positions, spec.rotary_dim, flags.rotary_interleaved)
+
+    new_cache = None
+    if kv_cache is not None:
+        k_cache, v_cache = kv_cache
+        k_full = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), cache_offset, axis=1
+        )
+        v_full = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), cache_offset, axis=1
+        )
+        new_cache = (k_full, v_full)
+        a = attention_fn(q, k_full.astype(q.dtype), v_full.astype(q.dtype), mask_bias)
+    else:
+        a = attention_fn(q, k, v, mask_bias)
+
+    a = _project(a.reshape(B, T, D), attn["wo"], attn.get("bo"))
+
+    if flags.parallel_block:
+        mlp_in = layer_norm(p["ln_2"], h, eps) if flags.separate_mlp_ln else x
+        m = _project(
+            gelu_new(_project(mlp_in, p["mlp"]["w_in"], p["mlp"]["b_in"])),
+            p["mlp"]["w_out"],
+            p["mlp"]["b_out"],
+        )
+        return h + a + m, new_cache
+
+    h = h + a
+    mlp_in = layer_norm(p["ln_2"], h, eps)
+    m = _project(
+        gelu_new(_project(mlp_in, p["mlp"]["w_in"], p["mlp"]["b_in"])),
+        p["mlp"]["w_out"],
+        p["mlp"]["b_out"],
+    )
+    return h + m, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Trunk application
+# ---------------------------------------------------------------------------
+
+
+def causal_mask_bias(
+    attention_mask: jnp.ndarray, dtype=jnp.float32
+) -> jnp.ndarray:
+    """Additive [B, 1, T, T] bias combining causality and padding.
+
+    attention_mask: [B, T] with 1 = real token.
+    """
+    B, T = attention_mask.shape
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    allowed = causal[None, :, :] & (attention_mask[:, None, :] > 0)
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
+
+
+def positions_from_mask(attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """Position ids that start at 0 on the first *real* token — correct under
+    left padding (the reference relies on HF's equivalent handling)."""
+    pos = jnp.cumsum(attention_mask, axis=-1) - 1
+    return jnp.maximum(pos, 0)
+
+
+def apply_blocks(
+    blocks: Params,
+    spec: ModelSpec,
+    h: jnp.ndarray,
+    mask_bias: jnp.ndarray,
+    positions: jnp.ndarray,
+    remat: bool = False,
+    attention_fn=attention_scores,
+) -> jnp.ndarray:
+    """Run stacked blocks over `h` with one lax.scan."""
+    flags = ArchFlags.for_spec(spec)
+
+    def body(carry, p_layer):
+        out, _ = block_apply(
+            spec, flags, p_layer, carry, mask_bias, positions,
+            attention_fn=attention_fn,
+        )
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if n_layers == 0:
+        return h
+    h, _ = jax.lax.scan(body, h, blocks)
+    return h
+
+
+def embed_tokens(
+    embed: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    compute_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    # JAX clamps out-of-bounds gathers silently; catch over-length sequences
+    # at trace time instead of silently reusing the last position embedding.
+    if tokens.shape[-1] > spec.n_positions:
+        raise ValueError(
+            f"sequence length {tokens.shape[-1]} exceeds n_positions "
+            f"{spec.n_positions}"
+        )
+    h = embed["wte"][tokens].astype(compute_dtype)
+    if "wpe" in embed:
+        h = h + embed["wpe"][positions].astype(compute_dtype)
+    return h
+
+
+def project_logits(embed: Params, spec: ModelSpec, h_normed: jnp.ndarray) -> jnp.ndarray:
+    """(Tied or untied) LM head on already-layernormed hidden; float32 logits."""
+    if spec.tie_lm_head:
+        logits = h_normed @ embed["wte"].T.astype(h_normed.dtype)
+    else:
+        head = embed["lm_head"]
+        logits = h_normed @ head["w"].astype(h_normed.dtype) + head["b"].astype(
+            h_normed.dtype
+        )
+    return logits.astype(jnp.float32)
+
+
+def lm_logits(
+    embed: Params, ln_f: Params, spec: ModelSpec, h: jnp.ndarray
+) -> jnp.ndarray:
+    """Final layernorm + LM head; returns float32 logits."""
+    return project_logits(embed, spec, layer_norm(ln_f, h, spec.layer_norm_epsilon))

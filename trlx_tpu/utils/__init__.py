@@ -12,6 +12,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -63,8 +64,9 @@ def cosine_schedule(lr_init: float, total_steps: int, lr_min: float = 1e-9) -> o
 
 def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
     """Keep the top-k entries of the last axis, set the rest to -inf
-    (parity: reference utils/__init__.py:94-103)."""
-    kth = jnp.sort(xs, axis=-1)[..., -k][..., None]
+    (parity: reference utils/__init__.py:94-103). Uses lax.top_k rather
+    than a full vocab sort — this runs per decode step."""
+    kth = jax.lax.top_k(xs, k)[0][..., -1:]
     return jnp.where(xs < kth, -jnp.inf, xs)
 
 
@@ -72,8 +74,8 @@ class Clock:
     """Wall-time / throughput helper (parity: reference
     utils/__init__.py:50-88).
 
-    `tick(samples)` records a timing mark; `get_stat("time/...", n)` reports
-    average seconds per `n` samples since the last reset.
+    `tick(samples)` records a timing mark; `get_stat(n)` reports average
+    seconds per `n` samples (optionally resetting the accumulators).
     """
 
     def __init__(self, window: int = 1000):
