@@ -1,0 +1,187 @@
+"""Jitted autoregressive generation with a static-shape KV cache.
+
+Replaces HF `generate` (reference: trlx/model/accelerate_base_model.py:119-123)
+and the ILQL hand-rolled KV-cache loop (reference:
+trlx/model/nn/ilql_models.py:216-260) with one compiled program:
+
+- prefill: one full forward over the (left-padded) prompt filling the cache;
+- decode: `lax.scan` over `gen_size` steps, each a single-token forward
+  against the cache — static shapes, no host round-trips, pjit-shardable;
+- fixed-length generation with eos masking (the reference configs pin
+  min_length == max_length, reference: configs/ppo_config.yml:48-49): after
+  a row emits eos, it keeps emitting pad tokens and `gen_mask` goes 0.
+
+An optional `extras_fn(h_normed, logits) -> logits` hook lets ILQL shift
+logits by beta * (Q - V) at each step without a second implementation.
+"""
+
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelSpec
+from trlx_tpu.models.transformer import (
+    NEG_INF,
+    apply_blocks_with_cache,
+    attention_scores,
+    causal_mask_bias,
+    embed_tokens,
+    init_kv_cache,
+    layer_norm,
+    positions_from_mask,
+    project_logits,
+)
+from trlx_tpu.ops.sampling import SamplingParams, sample_token
+
+Params = Dict[str, Any]
+
+
+class GenerationConfig(NamedTuple):
+    """Static generation settings (hashable, jit-cache friendly).
+
+    Mirrors the reference gen_kwargs contract
+    (reference: trlx/data/method_configs.py:74 `gen_kwargs`):
+    fixed `gen_size` new tokens; sampling per SamplingParams; eos handling.
+    """
+
+    gen_size: int
+    sampling: SamplingParams = SamplingParams()
+    eos_token_id: int = -1  # -1 disables eos termination
+    pad_token_id: int = 0
+
+    @classmethod
+    def from_gen_kwargs(cls, gen_size: int, gen_kwargs: dict, eos_token_id=-1,
+                        pad_token_id=0) -> "GenerationConfig":
+        """Translate reference-style gen_kwargs (max_length/top_k/top_p/
+        do_sample/temperature) into a GenerationConfig."""
+        return cls(
+            gen_size=gen_size,
+            sampling=SamplingParams(
+                temperature=float(gen_kwargs.get("temperature", 1.0)),
+                top_k=int(gen_kwargs.get("top_k", 0) or 0),
+                top_p=float(gen_kwargs.get("top_p", 1.0)),
+                do_sample=bool(gen_kwargs.get("do_sample", True)),
+            ),
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+        )
+
+
+class GenerationOutput(NamedTuple):
+    sequences: jnp.ndarray  # [B, P+G] prompt ++ generated (pads after eos)
+    gen_tokens: jnp.ndarray  # [B, G]
+    gen_logprobs: jnp.ndarray  # [B, G] logprob of emitted token (unwarped dist)
+    gen_mask: jnp.ndarray  # [B, G] 1 while not finished (includes eos token)
+    attention_mask: jnp.ndarray  # [B, P+G] prompt mask ++ ones
+
+
+def generate(
+    spec: ModelSpec,
+    blocks: Params,
+    embed: Params,
+    ln_f: Params,
+    prompt_tokens: jnp.ndarray,
+    prompt_mask: jnp.ndarray,
+    rng: jax.Array,
+    config: GenerationConfig,
+    compute_dtype=jnp.bfloat16,
+    cache_dtype=jnp.bfloat16,
+    extras_fn: Optional[Callable] = None,
+    attention_fn=attention_scores,
+) -> GenerationOutput:
+    """Sample `config.gen_size` tokens per row from a left-padded prompt.
+
+    blocks: full stacked [L, ...] live-policy blocks; embed/ln_f: head params.
+    Everything inside is static-shape; wrap in jit (or pjit via the trainer).
+    """
+    B, P = prompt_tokens.shape
+    G = config.gen_size
+    S = P + G
+    if S > spec.n_positions:
+        raise ValueError(
+            f"prompt ({P}) + gen_size ({G}) = {S} exceeds the model's "
+            f"n_positions ({spec.n_positions})"
+        )
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+
+    prompt_mask = prompt_mask.astype(jnp.int32)
+    real_len = prompt_mask.sum(axis=-1)  # [B]
+
+    # --- prefill ---------------------------------------------------------
+    cache = init_kv_cache(spec, n_layers, B, S, cache_dtype)
+    positions = positions_from_mask(prompt_mask)
+    h = embed_tokens(embed, spec, prompt_tokens, positions, compute_dtype)
+    # [B, 1, P, S] bias: causal over prompt slots, pad keys excluded, future
+    # (generation) slots excluded.
+    prefill_bias = jnp.concatenate(
+        [
+            causal_mask_bias(prompt_mask),
+            jnp.full((B, 1, P, G), NEG_INF, jnp.float32),
+        ],
+        axis=-1,
+    )
+    h, cache = apply_blocks_with_cache(
+        blocks, cache, spec, h, prefill_bias, positions,
+        cache_offset=jnp.int32(0), attention_fn=attention_fn,
+    )
+    h_last = layer_norm(ln_f, h[:, -1:], spec.layer_norm_epsilon)
+    logits0 = project_logits(embed, spec, h_last)[:, 0]  # [B, V]
+
+    buffer_mask = jnp.concatenate(
+        [prompt_mask, jnp.ones((B, G), jnp.int32)], axis=-1
+    )  # [B, S] validity of each cache slot once written
+    slot_idx = jnp.arange(S)
+
+    # -- decode scan ------------------------------------------------------
+    def decode_body(carry, step):
+        cache, logits, h_prev_normed, finished, rng = carry
+        rng, key = jax.random.split(rng)
+        step_logits = logits
+        if extras_fn is not None:
+            step_logits = extras_fn(h_prev_normed, step_logits)
+        tok = sample_token(key, step_logits, config.sampling)
+        logprob = jnp.take_along_axis(
+            jax.nn.log_softmax(step_logits, axis=-1), tok[:, None], axis=-1
+        )[:, 0]
+        tok = jnp.where(finished, jnp.int32(config.pad_token_id), tok)
+        logprob = jnp.where(finished, 0.0, logprob)
+        emitted_mask = ~finished
+        if config.eos_token_id >= 0:
+            finished = finished | (tok == config.eos_token_id)
+
+        # one-token forward against the cache
+        offset = P + step
+        pos = (real_len + step)[:, None]  # [B, 1] logical position
+        h = embed_tokens(embed, spec, tok[:, None], pos, compute_dtype)
+        key_valid = (slot_idx[None, :] <= offset) & (buffer_mask > 0)
+        bias = jnp.where(key_valid, 0.0, NEG_INF)[:, None, None, :].astype(
+            jnp.float32
+        )
+        h, cache = apply_blocks_with_cache(
+            blocks, cache, spec, h, bias, pos,
+            cache_offset=offset, attention_fn=attention_fn,
+        )
+        h_normed = layer_norm(ln_f, h, spec.layer_norm_epsilon)
+        next_logits = project_logits(embed, spec, h_normed)[:, 0]
+        carry = (cache, next_logits, h_normed[:, 0], finished, rng)
+        return carry, (tok, logprob, emitted_mask)
+
+    h0_normed = h_last[:, 0]
+    finished0 = jnp.zeros((B,), bool)
+    carry0 = (cache, logits0, h0_normed, finished0, rng)
+    _, (gen_tokens, gen_logprobs, gen_mask) = jax.lax.scan(
+        decode_body, carry0, jnp.arange(G)
+    )
+    gen_tokens = gen_tokens.T  # [B, G]
+    gen_logprobs = gen_logprobs.T
+    gen_mask = gen_mask.T.astype(jnp.int32)
+
+    sequences = jnp.concatenate([prompt_tokens, gen_tokens], axis=-1)
+    return GenerationOutput(
+        sequences=sequences,
+        gen_tokens=gen_tokens,
+        gen_logprobs=gen_logprobs,
+        gen_mask=gen_mask,
+        attention_mask=buffer_mask,
+    )

@@ -354,6 +354,58 @@ def apply_blocks(
     return h
 
 
+def init_kv_cache(
+    spec: ModelSpec,
+    n_layers: int,
+    batch: int,
+    buffer_len: int,
+    dtype=jnp.bfloat16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(k, v) cache buffers of shape [L, B, buffer_len, H, hd]."""
+    shape = (n_layers, batch, buffer_len, spec.n_head, spec.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def apply_blocks_with_cache(
+    blocks: Params,
+    cache: Tuple[jnp.ndarray, jnp.ndarray],
+    spec: ModelSpec,
+    h: jnp.ndarray,
+    mask_bias: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache_offset: jnp.ndarray,
+    attention_fn=attention_scores,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Run stacked blocks writing/reading the KV cache (prefill or decode).
+
+    h: [B, T, D] fresh suffix; cache: ([L, B, S, H, hd], ...) full buffers;
+    mask_bias: [B, 1, T, S] against the buffer; cache_offset: scalar buffer
+    index where the fresh suffix starts.
+    """
+    flags = ArchFlags.for_spec(spec)
+
+    def body(carry, xs):
+        p_layer, k_layer, v_layer = xs
+        out, new_cache = block_apply(
+            spec,
+            flags,
+            p_layer,
+            carry,
+            mask_bias,
+            positions,
+            kv_cache=(k_layer, v_layer),
+            cache_offset=cache_offset,
+            attention_fn=attention_fn,
+        )
+        return out, new_cache
+
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if n_layers == 0:
+        return h, cache
+    h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache[0], cache[1]))
+    return h, (new_k, new_v)
+
+
 def embed_tokens(
     embed: Params,
     spec: ModelSpec,

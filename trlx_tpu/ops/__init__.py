@@ -1,0 +1,5 @@
+"""Numerical ops: losses, GAE, sampling warpers, attention kernels.
+
+Replaces reference trlx/utils/modeling.py and the inline loss math in the
+trainers with jit-native equivalents.
+"""
