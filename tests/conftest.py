@@ -8,6 +8,8 @@ the standard JAX technique for SPMD tests. Must run before jax initializes.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# never stall on hub retries in tests; local files / fallbacks only
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()

@@ -1,0 +1,173 @@
+"""Golden-value tests for the PPO math against independent numpy replicas of
+the reference formulas (reference: trlx/model/accelerate_ppo_model.py:65-119,
+trlx/utils/modeling.py:5-29)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.ops.losses import (
+    gae_advantages,
+    kl_penalty_rewards,
+    logprobs_from_logits,
+    masked_mean,
+    ppo_losses,
+    whiten,
+)
+from trlx_tpu.trainers.kl_controllers import (
+    AdaptiveKLController,
+    FixedKLController,
+    make_kl_controller,
+)
+
+rng = np.random.default_rng(0)
+
+
+def np_gae(values, rewards, gamma, lam):
+    """Independent replica of the reference's reverse loop
+    (accelerate_ppo_model.py:68-84)."""
+    B, T = values.shape
+    advs = np.zeros_like(values)
+    lastgaelam = np.zeros(B)
+    for t in reversed(range(T)):
+        nextvalues = values[:, t + 1] if t < T - 1 else np.zeros(B)
+        delta = rewards[:, t] + gamma * nextvalues - values[:, t]
+        lastgaelam = delta + gamma * lam * lastgaelam
+        advs[:, t] = lastgaelam
+    return advs, advs + values
+
+
+def test_gae_matches_reference_loop():
+    values = rng.normal(size=(3, 7)).astype(np.float32)
+    rewards = rng.normal(size=(3, 7)).astype(np.float32)
+    for gamma, lam in [(1.0, 0.95), (0.9, 0.5), (1.0, 1.0)]:
+        adv, ret = jax.jit(gae_advantages, static_argnums=(2, 3))(
+            jnp.asarray(values), jnp.asarray(rewards), gamma, lam
+        )
+        adv_np, ret_np = np_gae(values, rewards, gamma, lam)
+        np.testing.assert_allclose(np.asarray(adv), adv_np, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(ret), ret_np, rtol=1e-5, atol=1e-6)
+
+
+def test_whiten():
+    x = rng.normal(loc=3.0, scale=2.0, size=(4, 9)).astype(np.float32)
+    w = np.asarray(whiten(jnp.asarray(x)))
+    np.testing.assert_allclose(w.mean(), 0.0, atol=1e-5)
+    np.testing.assert_allclose(w.std(), 1.0, atol=1e-3)
+    w2 = np.asarray(whiten(jnp.asarray(x), shift_mean=False))
+    np.testing.assert_allclose(w2.mean(), x.mean(), atol=1e-4)
+
+
+def test_whiten_masked_ignores_padding():
+    x = rng.normal(size=(2, 6)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], np.float32)
+    w = np.asarray(whiten(jnp.asarray(x), mask=jnp.asarray(mask)))
+    real = w[mask.astype(bool)]
+    np.testing.assert_allclose(real.mean(), 0.0, atol=1e-5)
+
+
+def test_logprobs_from_logits():
+    logits = rng.normal(size=(2, 5, 11)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(2, 5))
+    got = np.asarray(
+        logprobs_from_logits(jnp.asarray(logits), jnp.asarray(labels))
+    )
+    ref = np.take_along_axis(
+        logits - np.log(np.exp(logits).sum(-1, keepdims=True)),
+        labels[..., None],
+        axis=-1,
+    )[..., 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def np_ppo_loss(logprobs, values, old_logprobs, old_values, advantages, returns,
+                cliprange, cliprange_value, vf_coef):
+    """Independent replica of reference accelerate_ppo_model.py:95-119."""
+    vpredclipped = np.clip(values, old_values - cliprange_value,
+                           old_values + cliprange_value)
+    vf_loss = 0.5 * np.maximum((values - returns) ** 2,
+                               (vpredclipped - returns) ** 2).mean()
+    ratio = np.exp(logprobs - old_logprobs)
+    pg_loss = np.maximum(
+        -advantages * ratio,
+        -advantages * np.clip(ratio, 1 - cliprange, 1 + cliprange),
+    ).mean()
+    return pg_loss + vf_coef * vf_loss, pg_loss, vf_loss
+
+
+def test_ppo_losses_golden():
+    shape = (4, 6)
+    logprobs = rng.normal(size=shape).astype(np.float32) * 0.1 - 2
+    old_logprobs = logprobs + rng.normal(size=shape).astype(np.float32) * 0.05
+    values = rng.normal(size=shape).astype(np.float32)
+    old_values = values + rng.normal(size=shape).astype(np.float32) * 0.1
+    advantages = rng.normal(size=shape).astype(np.float32)
+    returns = rng.normal(size=shape).astype(np.float32)
+
+    loss, stats = jax.jit(ppo_losses, static_argnums=(6, 7, 8))(
+        *map(jnp.asarray, (logprobs, values, old_logprobs, old_values,
+                           advantages, returns)),
+        0.2, 0.2, 2.3,
+    )
+    expected, pg, vf = np_ppo_loss(
+        logprobs, values, old_logprobs, old_values, advantages, returns,
+        0.2, 0.2, 2.3,
+    )
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    np.testing.assert_allclose(float(stats["pg_loss"]), pg, rtol=1e-5)
+    np.testing.assert_allclose(float(stats["vf_loss"]), vf, rtol=1e-5)
+
+
+def test_kl_penalty_rewards():
+    logprobs = rng.normal(size=(2, 4)).astype(np.float32)
+    ref_logprobs = rng.normal(size=(2, 4)).astype(np.float32)
+    scores = np.array([1.5, -0.5], np.float32)
+    rewards, seq_kl = jax.jit(kl_penalty_rewards)(
+        jnp.asarray(logprobs), jnp.asarray(ref_logprobs), jnp.asarray(scores),
+        jnp.float32(0.2),
+    )
+    kls = logprobs - ref_logprobs
+    expected = -0.2 * kls
+    expected[:, -1] += scores
+    np.testing.assert_allclose(np.asarray(rewards), expected, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(seq_kl), kls.mean(-1), rtol=1e-5)
+
+
+def test_kl_penalty_rewards_masked_places_score_on_last_real_token():
+    logprobs = rng.normal(size=(2, 5)).astype(np.float32)
+    ref_logprobs = rng.normal(size=(2, 5)).astype(np.float32)
+    scores = np.array([2.0, 3.0], np.float32)
+    mask = jnp.asarray(np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.int32))
+    rewards, _ = jax.jit(kl_penalty_rewards)(
+        jnp.asarray(logprobs), jnp.asarray(ref_logprobs), jnp.asarray(scores),
+        jnp.float32(0.1), mask,
+    )
+    r = np.asarray(rewards)
+    kls = (logprobs - ref_logprobs) * np.asarray(mask)
+    assert np.isclose(r[0, 2], -0.1 * kls[0, 2] + 2.0)  # last real token row 0
+    assert np.isclose(r[1, 4], -0.1 * kls[1, 4] + 3.0)
+    assert (r[0, 3:] == 0).all()  # padded slots carry no reward
+
+
+def test_adaptive_kl_controller():
+    """Replica of reference accelerate_ppo_model.py:24-34 dynamics."""
+    c = AdaptiveKLController(init_kl_coef=0.2, target=6.0, horizon=10000)
+    c.update(current_kl=12.0, n_steps=256)  # error clipped to +0.2
+    np.testing.assert_allclose(c.value, 0.2 * (1 + 0.2 * 256 / 10000), rtol=1e-6)
+    c2 = AdaptiveKLController(0.2, 6.0, 10000)
+    c2.update(current_kl=0.0, n_steps=256)  # error clipped to -0.2
+    np.testing.assert_allclose(c2.value, 0.2 * (1 - 0.2 * 256 / 10000), rtol=1e-6)
+
+
+def test_fixed_kl_controller_and_factory():
+    f = FixedKLController(0.1)
+    f.update(100.0, 10)
+    assert f.value == 0.1
+    assert isinstance(make_kl_controller(0.2, None, 100), FixedKLController)
+    assert isinstance(make_kl_controller(0.2, 6, 100), AdaptiveKLController)
+
+
+def test_masked_mean():
+    x = jnp.asarray(np.array([[1.0, 2.0, 100.0]], np.float32))
+    m = jnp.asarray(np.array([[1, 1, 0]], np.float32))
+    assert float(masked_mean(x, m)) == 1.5

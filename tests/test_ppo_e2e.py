@@ -1,0 +1,192 @@
+"""End-to-end PPO: the full four-piece loop (pipeline → orchestrator →
+store → trainer) learns a synthetic reward on a tiny from-config model.
+
+This is the promotion of the reference's de-facto integration-test style
+(deterministic synthetic task, from-config tiny model, programmatic reward —
+reference: examples/ilql_randomwalks.py) to the PPO path, which the
+reference never tests end-to-end.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+
+def make_config(total_steps=60, batch_size=16, num_layers_unfrozen=1,
+                learning_rate=3e-3, epochs=100, ppo_epochs=2,
+                num_rollouts=32, chunk_size=16):
+    return TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": "from-config",
+                "tokenizer_path": "byte",
+                "model_type": "JaxPPOTrainer",
+                "num_layers_unfrozen": num_layers_unfrozen,
+                "model_spec": {
+                    "vocab_size": 257,
+                    "n_layer": 2,
+                    "n_head": 4,
+                    "d_model": 64,
+                    "n_positions": 32,
+                },
+                "compute_dtype": "float32",
+            },
+            "train": {
+                "n_ctx": 32,
+                "epochs": epochs,
+                "total_steps": total_steps,
+                "batch_size": batch_size,
+                "grad_clip": 1.0,
+                "lr_ramp_steps": 0,
+                "lr_decay_steps": total_steps,
+                "weight_decay": 1e-6,
+                "learning_rate_init": learning_rate,
+                "learning_rate_target": learning_rate,
+                "log_interval": 1000,
+                "checkpoint_interval": 10**9,
+                "eval_interval": 10**9,
+                "pipeline": "PPOPipeline",
+                "orchestrator": "PPOOrchestrator",
+                "input_size": 4,
+                "gen_size": 8,
+                "seed": 0,
+            },
+            "method": {
+                "name": "ppoconfig",
+                "num_rollouts": num_rollouts,
+                "chunk_size": chunk_size,
+                "ppo_epochs": ppo_epochs,
+                "init_kl_coef": 0.02,
+                "target": 6.0,
+                "horizon": 10000,
+                "gamma": 1.0,
+                "lam": 0.95,
+                "cliprange": 0.2,
+                "cliprange_value": 0.2,
+                "vf_coef": 1.0,
+                "gen_kwargs": {
+                    "max_length": 8,
+                    "min_length": 8,
+                    "top_k": 0,
+                    "top_p": 1.0,
+                    "do_sample": True,
+                },
+            },
+        }
+    )
+
+
+PROMPTS = ["the ", "a qu", "some", "word", "text", "abcd", "lore", "ipsu"] * 4
+
+
+def reward_fn(texts):
+    """Dense synthetic reward: fraction of lowercase letters in the text.
+    Combined with a printable-ASCII logit mask (lossless ByteTokenizer
+    decode), every rollout gets a distinct, crisp score — a tiny random-init
+    model demonstrably learns this in a few rounds, unlike sparse
+    token-count rewards."""
+    return [float(np.mean([c.islower() for c in t] or [0.0])) for t in texts]
+
+
+PRINTABLE_MASK = np.zeros(257, bool)
+PRINTABLE_MASK[32:127] = True
+
+
+@functools.lru_cache(maxsize=None)
+def build():
+    config = make_config()
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    return config, trainer, pipeline, orch
+
+
+
+
+def test_make_experience_fills_store_with_correct_shapes():
+    config, trainer, pipeline, orch = build()
+    trainer.store.clear_history()
+    info = orch.make_experience(config.method.num_rollouts)
+    assert len(trainer.store) == 32
+    batch = next(iter(trainer.store.create_loader(8)))
+    assert batch.query_tensors.shape == (8, 4)
+    assert batch.response_tensors.shape == (8, 8)
+    assert batch.logprobs.shape == (8, 8)
+    assert batch.values.shape == (8, 8)
+    assert batch.rewards.shape == (8, 8)
+    assert np.isfinite(batch.logprobs).all()
+    assert np.isfinite(batch.rewards).all()
+    assert info["rollouts"] == 32
+
+
+def test_train_step_improves_loss_on_fixed_batch():
+    config, trainer, pipeline, orch = build()
+    trainer.store.clear_history()
+    orch.make_experience(config.method.num_rollouts)
+    import jax
+
+    batch = next(iter(trainer.store.create_loader(16)))
+    batch = jax.tree_util.tree_map(np.asarray, batch)
+    losses = []
+    for _ in range(4):
+        trainer.params, trainer.opt_state, stats = trainer._train_step(
+            trainer.params, trainer.opt_state, batch
+        )
+        losses.append(float(stats["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_ppo_learns_synthetic_reward():
+    """The full loop (learn() driving make_experience per epoch) must raise
+    the dense synthetic reward measurably. Deterministic: fixed PRNG seed,
+    seeded loaders, deterministic reward."""
+    from trlx_tpu.utils.loading import get_model as _gm
+
+    config = make_config(
+        total_steps=10**9,
+        batch_size=32,
+        num_layers_unfrozen=-1,
+        learning_rate=6e-2,
+        epochs=12,
+        ppo_epochs=3,
+        num_rollouts=64,
+        chunk_size=32,
+    )
+    config.train.gen_size = 4
+    config.method.gen_kwargs.update(max_length=4, min_length=4)
+    trainer = _gm(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    trainer.set_logit_mask(PRINTABLE_MASK)
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+
+    orch.make_experience(config.method.num_rollouts)
+    logs = []
+    trainer.learn(log_fn=logs.append)
+    scores = [s["mean_score"] for s in logs if "mean_score" in s]
+    assert len(scores) >= 8, f"expected per-epoch rollout logs, got {len(scores)}"
+    early = float(np.mean(scores[:3]))
+    late = float(np.mean(scores[-3:]))
+    # each mean_score averages 64 rollouts; noise sigma ~0.02, expected
+    # drift ~0.06+ (mean generated byte rises ~8 points / 128)
+    assert late > early + 0.03, (
+        f"PPO did not learn: early rollout score={early:.4f} "
+        f"late={late:.4f} (all: {[round(s, 4) for s in scores]})"
+    )

@@ -29,7 +29,11 @@ class PPORLElement:
     :param values: value-head outputs aligned with response tokens,
         [response_size]
     :param rewards: per-token rewards (KL penalty everywhere, score added on
-        the final token), [response_size]
+        the last real token), [response_size]
+    :param response_mask: 1 for real response tokens, 0 for pads emitted
+        after eos, [response_size]. The reference has no equivalent because
+        it only ever generates fixed-length responses; with eos termination
+        active, losses/KL must exclude pad positions.
     """
 
     query_tensor: np.ndarray
@@ -37,6 +41,7 @@ class PPORLElement:
     logprobs: np.ndarray
     values: np.ndarray
     rewards: np.ndarray
+    response_mask: np.ndarray = None
 
 
 @register_batch_pytree
@@ -49,6 +54,7 @@ class PPORLBatch:
     :param logprobs: [batch, response_size]
     :param values: [batch, response_size]
     :param rewards: [batch, response_size]
+    :param response_masks: [batch, response_size]
     """
 
     query_tensors: np.ndarray
@@ -56,18 +62,25 @@ class PPORLBatch:
     logprobs: np.ndarray
     values: np.ndarray
     rewards: np.ndarray
+    response_masks: np.ndarray
 
     def __len__(self) -> int:
         return int(self.query_tensors.shape[0])
 
     @classmethod
     def stack(cls, elements) -> "PPORLBatch":
+        def mask_of(e):
+            if e.response_mask is not None:
+                return e.response_mask
+            return np.ones_like(e.response_tensor, dtype=np.int32)
+
         return cls(
             query_tensors=np.stack([e.query_tensor for e in elements]),
             response_tensors=np.stack([e.response_tensor for e in elements]),
             logprobs=np.stack([e.logprobs for e in elements]),
             values=np.stack([e.values for e in elements]),
             rewards=np.stack([e.rewards for e in elements]),
+            response_masks=np.stack([mask_of(e) for e in elements]),
         )
 
     def unstack(self):
@@ -78,6 +91,7 @@ class PPORLBatch:
                 self.logprobs[i],
                 self.values[i],
                 self.rewards[i],
+                self.response_masks[i],
             )
             for i in range(len(self))
         ]

@@ -49,12 +49,25 @@ class GenerationConfig(NamedTuple):
     sampling: SamplingParams = SamplingParams()
     eos_token_id: int = -1  # -1 disables eos termination
     pad_token_id: int = 0
+    min_new_tokens: int = 0  # eos suppressed before this many tokens
 
     @classmethod
     def from_gen_kwargs(cls, gen_size: int, gen_kwargs: dict, eos_token_id=-1,
-                        pad_token_id=0) -> "GenerationConfig":
-        """Translate reference-style gen_kwargs (max_length/top_k/top_p/
-        do_sample/temperature) into a GenerationConfig."""
+                        pad_token_id=0, prompt_len: int = 0) -> "GenerationConfig":
+        """Translate reference-style gen_kwargs (max_length/min_length/top_k/
+        top_p/do_sample/temperature) into a GenerationConfig.
+
+        HF's min_length counts prompt + generated tokens, so min_new_tokens
+        = min_length - prompt_len. The reference configs pin min_length ==
+        max_length (configs/ppo_config.yml:48-49), which means fixed-length
+        generation — translated as min_new_tokens == gen_size (eos fully
+        suppressed)."""
+        min_len = int(gen_kwargs.get("min_length", 0) or 0)
+        max_len = int(gen_kwargs.get("max_length", 0) or 0)
+        if min_len and min_len >= max_len:
+            min_new = gen_size
+        else:
+            min_new = max(0, min(min_len - prompt_len, gen_size))
         return cls(
             gen_size=gen_size,
             sampling=SamplingParams(
@@ -65,6 +78,7 @@ class GenerationConfig(NamedTuple):
             ),
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
+            min_new_tokens=min_new,
         )
 
 
@@ -89,11 +103,16 @@ def generate(
     cache_dtype=jnp.bfloat16,
     extras_fn: Optional[Callable] = None,
     attention_fn=attention_scores,
+    logit_mask: Optional[jnp.ndarray] = None,
 ) -> GenerationOutput:
     """Sample `config.gen_size` tokens per row from a left-padded prompt.
 
     blocks: full stacked [L, ...] live-policy blocks; embed/ln_f: head params.
     Everything inside is static-shape; wrap in jit (or pjit via the trainer).
+
+    `logit_mask`: optional [V] (or [B, V]) boolean array; False entries are
+    excluded from sampling at every step (the reference uses this for the
+    randomwalks graph-edge restriction, examples/ilql_randomwalks.py:72).
     """
     B, P = prompt_tokens.shape
     G = config.gen_size
@@ -140,6 +159,14 @@ def generate(
         step_logits = logits
         if extras_fn is not None:
             step_logits = extras_fn(h_prev_normed, step_logits)
+        if logit_mask is not None:
+            step_logits = jnp.where(logit_mask, step_logits, NEG_INF)
+        if config.eos_token_id >= 0 and config.min_new_tokens > 0:
+            suppress = step < config.min_new_tokens
+            eos_col = step_logits[:, config.eos_token_id]
+            step_logits = step_logits.at[:, config.eos_token_id].set(
+                jnp.where(suppress, NEG_INF, eos_col)
+            )
         tok = sample_token(key, step_logits, config.sampling)
         logprob = jnp.take_along_axis(
             jax.nn.log_softmax(step_logits, axis=-1), tok[:, None], axis=-1

@@ -13,7 +13,7 @@ deserialization — nothing torch touches the TPU.
 Supported arches: gpt2 (incl. gpt2-imdb/xl), gptj (gpt-j-6B), gptneox.
 """
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -236,17 +236,33 @@ def convert_state_dict(sd, spec: ModelSpec) -> Tuple[Params, Params, Params]:
     return _CONVERTERS[spec.arch.lower()](sd, spec)
 
 
-def load_trunk_from_hf(model_path: str):
+def load_trunk_from_hf(model_path: str, local_files_only: Optional[bool] = None):
     """Load an HF causal-LM checkpoint (local dir or cached hub name) and
-    return (spec, embed, blocks, ln_f) as numpy pytrees."""
+    return (spec, embed, blocks, ln_f) as numpy pytrees.
+
+    Local files are tried first so offline environments fail fast instead of
+    stalling on hub retries (shared policy: trlx_tpu.utils.hf_offline)."""
     from transformers import AutoConfig, AutoModelForCausalLM
 
-    hf_config = AutoConfig.from_pretrained(model_path)
-    spec = spec_from_hf_config(hf_config)
-    model = AutoModelForCausalLM.from_pretrained(model_path)
-    sd = model.state_dict()
-    embed, blocks, ln_f = convert_state_dict(sd, spec)
-    return spec, embed, blocks, ln_f
+    from trlx_tpu.utils.hf_offline import local_first_attempts
+
+    attempts = (
+        [{"local_files_only": local_files_only}]
+        if local_files_only is not None
+        else list(local_first_attempts())
+    )
+    last_err = None
+    for kw in attempts:
+        try:
+            hf_config = AutoConfig.from_pretrained(model_path, **kw)
+            spec = spec_from_hf_config(hf_config)
+            model = AutoModelForCausalLM.from_pretrained(model_path, **kw)
+            sd = model.state_dict()
+            embed, blocks, ln_f = convert_state_dict(sd, spec)
+            return spec, embed, blocks, ln_f
+        except Exception as e:  # noqa: BLE001 - propagate last attempt below
+            last_err = e
+    raise last_err
 
 
 def hydra_params_from_trunk(

@@ -1,0 +1,164 @@
+"""RL loss math: whitening, logprob gathering, GAE, clipped PPO losses.
+
+Parity targets:
+- whiten / clip_by_value / logprobs_from_logits —
+  reference trlx/utils/modeling.py:5-29
+- GAE reverse recursion — reference trlx/model/accelerate_ppo_model.py:68-82
+  (a Python for-loop there; a `lax.scan` here)
+- clipped value + policy losses — reference accelerate_ppo_model.py:84-119
+
+All functions are pure, jit-safe, and take an optional response mask; with an
+all-ones mask they reduce exactly to the reference's unmasked math (the
+reference generates fixed-length responses so it never masks).
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean(x: jnp.ndarray, mask: Optional[jnp.ndarray], axis=None) -> jnp.ndarray:
+    if mask is None:
+        return x.mean(axis=axis)
+    mask = mask.astype(x.dtype)
+    return (x * mask).sum(axis=axis) / jnp.maximum(mask.sum(axis=axis), 1.0)
+
+
+def whiten(
+    x: jnp.ndarray,
+    shift_mean: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Normalize to zero mean / unit variance
+    (parity: reference trlx/utils/modeling.py:5-11)."""
+    mean = masked_mean(x, mask)
+    var = masked_mean((x - mean) ** 2, mask)
+    out = (x - mean) * jax.lax.rsqrt(var + 1e-8)
+    if not shift_mean:
+        out = out + mean
+    return out
+
+
+def clip_by_value(x: jnp.ndarray, low: jnp.ndarray, high: jnp.ndarray) -> jnp.ndarray:
+    """(parity: reference trlx/utils/modeling.py:14-20)"""
+    return jnp.clip(x, low, high)
+
+
+def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-token logprobs of `labels` under `logits`
+    (parity: reference trlx/utils/modeling.py:23-29).
+
+    logits: [..., T, V]; labels: [..., T] → [..., T]
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def gae_advantages(
+    values: jnp.ndarray,
+    rewards: jnp.ndarray,
+    gamma: float,
+    lam: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized advantage estimation over the response window.
+
+    values, rewards: [B, T] (time-major inside; batch API stays [B, T]).
+    Returns (advantages [B, T], returns = advantages + values), matching the
+    reference's reverse loop (accelerate_ppo_model.py:68-84) with V_{T} = 0
+    beyond the last token.
+
+    Implemented as a reverse `lax.scan` — O(T) sequential but fully fused,
+    no Python loop in the trace.
+    """
+    B, T = values.shape
+    v_next = jnp.concatenate([values[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1)
+    deltas = rewards + gamma * v_next - values  # [B, T]
+
+    def step(carry, delta_t):
+        adv = delta_t + gamma * lam * carry
+        return adv, adv
+
+    _, advs_rev = jax.lax.scan(
+        step, jnp.zeros((B,), values.dtype), deltas.T[::-1]
+    )
+    advantages = advs_rev[::-1].T
+    return advantages, advantages + values
+
+
+def ppo_losses(
+    logprobs: jnp.ndarray,
+    values: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    old_values: jnp.ndarray,
+    advantages: jnp.ndarray,
+    returns: jnp.ndarray,
+    cliprange: float,
+    cliprange_value: float,
+    vf_coef: float,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped-ratio policy loss + clipped value loss
+    (parity: reference accelerate_ppo_model.py:95-119).
+
+    All arrays [B, T] over the response window. Returns (total_loss, stats).
+    """
+    vpred_clipped = clip_by_value(
+        values, old_values - cliprange_value, old_values + cliprange_value
+    )
+    vf_unclipped = (values - returns) ** 2
+    vf_clipped = (vpred_clipped - returns) ** 2
+    vf_loss = 0.5 * masked_mean(jnp.maximum(vf_unclipped, vf_clipped), mask)
+    vf_clipfrac = masked_mean((vf_clipped > vf_unclipped).astype(jnp.float32), mask)
+
+    log_ratio = logprobs - old_logprobs
+    ratio = jnp.exp(log_ratio)
+    pg_unclipped = -advantages * ratio
+    pg_clipped = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss = masked_mean(jnp.maximum(pg_unclipped, pg_clipped), mask)
+    pg_clipfrac = masked_mean((pg_clipped > pg_unclipped).astype(jnp.float32), mask)
+
+    # mean KL between new and rollout policy, the reference's `approx_kl`
+    # analogue (accelerate_ppo_model.py:107 records mean (old-new))
+    mean_kl = masked_mean(-log_ratio, mask)
+
+    loss = pg_loss + vf_coef * vf_loss
+    stats = {
+        "loss": loss,
+        "pg_loss": pg_loss,
+        "vf_loss": vf_loss,
+        "pg_clipfrac": pg_clipfrac,
+        "vf_clipfrac": vf_clipfrac,
+        "approx_kl": mean_kl,
+        "ratio_mean": masked_mean(ratio, mask),
+    }
+    return loss, stats
+
+
+def kl_penalty_rewards(
+    logprobs: jnp.ndarray,
+    ref_logprobs: jnp.ndarray,
+    scores: jnp.ndarray,
+    kl_coef: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token KL-penalty rewards with the task score added on the last
+    (real) response token (parity: reference
+    trlx/orchestrator/ppo_orchestrator.py:89-92).
+
+    logprobs/ref_logprobs: [B, T]; scores: [B]; returns (rewards [B, T],
+    mean per-sequence KL [B]).
+    """
+    kls = logprobs - ref_logprobs
+    if mask is not None:
+        kls = kls * mask.astype(kls.dtype)
+    rewards = -kl_coef * kls
+    if mask is None:
+        rewards = rewards.at[:, -1].add(scores)
+        seq_kl = kls.mean(axis=-1)
+    else:
+        # index of last real token per row
+        last = jnp.maximum(mask.sum(axis=-1).astype(jnp.int32) - 1, 0)
+        rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
+        seq_kl = masked_mean(kls, mask, axis=-1)
+    return rewards, seq_kl

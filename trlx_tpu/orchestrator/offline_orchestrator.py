@@ -1,0 +1,1 @@
+"""Offline orchestrator — placeholder; lands with the ILQL stack milestone."""

@@ -1,0 +1,1 @@
+"""Offline (ILQL) pipeline — placeholder; lands with the ILQL stack milestone."""

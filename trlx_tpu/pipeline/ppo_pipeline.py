@@ -1,0 +1,136 @@
+"""PPO prompt pipeline and rollout storage.
+
+Parity target: reference trlx/pipeline/ppo_pipeline.py:15-121. Differences,
+deliberate:
+
+- The reference hardcodes the IMDB test split in the pipeline constructor
+  (reference: ppo_pipeline.py:19-38); here prompts are injected (with an
+  `from_imdb` convenience that needs HF datasets), keeping the pipeline
+  dataset-agnostic and offline-testable.
+- Storage is stacked-array chunks (jit-transparent `PPORLBatch`) instead of
+  per-sample tensor dataclasses collated per batch; no `[None]` dummy entry
+  (that was an Accelerate prepare() workaround, ppo_pipeline.py:74-76).
+- `capacity` is enforced as a ring bound (the reference declares but never
+  uses it, pipeline/__init__.py:67-69).
+"""
+
+from typing import Iterator, List, Optional
+
+import jax
+import numpy as np
+
+from trlx_tpu.data.ppo_types import PPORLBatch
+from trlx_tpu.pipeline import (
+    BasePipeline,
+    BaseRolloutStore,
+    batch_iterator,
+    register_datapipeline,
+)
+
+
+@register_datapipeline("PPOPipeline")
+class PPOPipeline(BasePipeline):
+    """Prompt dataset tokenized up-front to fixed `input_size` with left
+    padding (reference tokenizes everything up-front too,
+    ppo_pipeline.py:30-36)."""
+
+    def __init__(self, prompts: List[str], tokenizer, config):
+        super().__init__()
+        self.tokenizer = tokenizer
+        self.input_size = config.train.input_size
+        enc = tokenizer(
+            prompts,
+            max_length=self.input_size,
+            padding="max_length",
+            truncation=True,
+        )
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        if ids.shape[1] > self.input_size:  # HF tokenizers may not truncate
+            ids = ids[:, -self.input_size :]
+            mask = mask[:, -self.input_size :]
+        self.tokens = ids
+        self.masks = mask
+        self.text = prompts
+
+    @classmethod
+    def from_imdb(cls, tokenizer, config, max_prompts: int = 0):
+        """The reference's IMDB-test-split behavior
+        (reference: ppo_pipeline.py:19-29); requires HF datasets + network
+        or local cache."""
+        from datasets import load_dataset
+
+        ds = load_dataset("imdb", split="test")
+        prompts = [t for t in ds["text"] if len(t) < 500]
+        if max_prompts:
+            prompts = prompts[:max_prompts]
+        return cls(prompts, tokenizer, config)
+
+    def __getitem__(self, index: int):
+        return self.tokens[index], self.masks[index]
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, seed: int = 0,
+        drop_last: bool = True,
+    ) -> Iterator:
+        return batch_iterator(
+            len(self),
+            batch_size,
+            shuffle,
+            seed,
+            lambda idx: (self.tokens[idx], self.masks[idx]),
+            drop_last=drop_last,
+        )
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    """Append-only (optionally capacity-bounded) store of rollout chunks.
+
+    Parity: reference ppo_pipeline.py:67-117 (push / clear_history /
+    create_loader with stacked collate)."""
+
+    def __init__(self, capacity: int = -1):
+        super().__init__(capacity)
+        self.history: List[PPORLBatch] = []
+
+    def push(self, exps: PPORLBatch) -> None:
+        self.history.append(exps)
+        if self.capacity > 0:
+            total = sum(len(b) for b in self.history)
+            while total > self.capacity and len(self.history) > 1:
+                total -= len(self.history.pop(0))
+
+    def clear_history(self) -> None:
+        self.history = []
+
+    def _stacked(self) -> Optional[PPORLBatch]:
+        if not self.history:
+            return None
+        if len(self.history) == 1:
+            return self.history[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *self.history
+        )
+
+    def __getitem__(self, index: int):
+        return self._stacked().unstack()[index]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.history)
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, seed: int = 0
+    ) -> Iterator:
+        data = self._stacked()
+        if data is None:
+            return iter(())
+        return batch_iterator(
+            len(data),
+            batch_size,
+            shuffle,
+            seed,
+            lambda idx: jax.tree_util.tree_map(lambda x: x[idx], data),
+        )

@@ -1,0 +1,1 @@
+"""ILQL trainer — placeholder; lands with the ILQL stack milestone."""

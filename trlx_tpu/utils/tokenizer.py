@@ -1,0 +1,76 @@
+"""Tokenizer loading and an offline-safe fallback.
+
+The reference uses HF AutoTokenizer with left padding and eos-as-pad
+(reference: trlx/model/accelerate_base_model.py:43-45). We keep that, plus a
+dependency-free ByteTokenizer implementing the same minimal protocol for
+tests/examples in network-less environments (this build environment has no
+HF hub access).
+"""
+
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: token i (0..255) is byte i; 256 is
+    bos/eos/pad. Deterministic, reversible, needs no vocab files."""
+
+    vocab_size = 257
+
+    def __init__(self):
+        self.eos_token_id = 256
+        self.bos_token_id = 256
+        self.pad_token_id = 256
+        self.eos_token = "<|endoftext|>"
+        self.padding_side = "left"
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        data = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def batch_decode(self, batch, skip_special_tokens: bool = True) -> List[str]:
+        return [self.decode(row, skip_special_tokens) for row in batch]
+
+    def __call__(self, texts, max_length: Optional[int] = None,
+                 padding="max_length", truncation=True, **kw):
+        # padding/truncation accepted for HF-signature compatibility; this
+        # tokenizer always left-pads/truncates to max_length.
+        import numpy as np
+
+        if isinstance(texts, str):
+            texts = [texts]
+        enc = [self.encode(t) for t in texts]
+        if max_length is None:
+            max_length = max(len(e) for e in enc)
+        ids = np.full((len(enc), max_length), self.pad_token_id, np.int32)
+        mask = np.zeros((len(enc), max_length), np.int32)
+        for i, e in enumerate(enc):
+            e = e[:max_length]
+            ids[i, max_length - len(e):] = e  # left padding
+            mask[i, max_length - len(e):] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def load_tokenizer(tokenizer_path: str):
+    """AutoTokenizer with the reference's settings (left pad, eos as pad);
+    falls back to ByteTokenizer when the path is unavailable.
+
+    Tries local files first so offline environments don't stall on hub
+    retries; only goes to the network if the local lookup misses and the
+    environment hasn't opted out (HF_HUB_OFFLINE)."""
+    from trlx_tpu.utils.hf_offline import local_first_attempts
+
+    for kw in local_first_attempts():
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(tokenizer_path, **kw)
+            tok.padding_side = "left"
+            if tok.pad_token is None:
+                tok.pad_token = tok.eos_token
+            return tok
+        except Exception:
+            continue
+    return ByteTokenizer()
