@@ -1,0 +1,93 @@
+"""Synthetic random-walk graph task (shared by the example and the tests).
+
+Re-implementation of the reference's designed smoke-test task (reference:
+examples/ilql_randomwalks.py:19-96): a random directed graph over `n_nodes`
+nodes where node 0 is the goal; training data are random walks (token id ==
+node id); reward is the negative number of steps taken to reach the goal
+(or -100 if never reached); the quality metric is the percentage of optimal
+(BFS shortest-path) length achieved.
+"""
+
+from collections import deque
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+def generate_random_walks(
+    n_nodes: int = 21,
+    max_length: int = 10,
+    n_walks: int = 1000,
+    p_edge: float = 0.1,
+    seed: int = 1002,
+) -> Tuple[List[List[int]], np.ndarray, Callable, Callable]:
+    """Returns (walks, logit_mask, stats_fn, reward_fn).
+
+    walks: token-id lists; logit_mask: [V, V] bool, True = edge ABSENT
+    (disallowed transition), indexed by previous node — the reference's
+    `~adj` convention (examples/ilql_randomwalks.py:72).
+    """
+    rng = np.random.default_rng(seed)
+    adj = rng.random((n_nodes, n_nodes)) < p_edge
+    np.fill_diagonal(adj, False)
+    # every node needs at least one outgoing edge
+    for i in range(n_nodes):
+        if not adj[i].any():
+            j = int(rng.integers(0, n_nodes - 1))
+            adj[i, j if j < i else j + 1] = True
+
+    goal = 0
+
+    def walk_from(start: int) -> List[int]:
+        node, path = start, [start]
+        for _ in range(max_length - 1):
+            if node == goal:
+                break
+            node = int(rng.choice(np.flatnonzero(adj[node])))
+            path.append(node)
+        return path
+
+    walks = [walk_from(int(rng.integers(1, n_nodes))) for _ in range(n_walks)]
+
+    # BFS shortest path to goal from every node (for the optimality metric)
+    dist = np.full(n_nodes, np.inf)
+    dist[goal] = 0
+    q = deque([goal])
+    # reverse-edge BFS: dist[u] over edges u -> v
+    preds = [np.flatnonzero(adj[:, v]) for v in range(n_nodes)]
+    while q:
+        v = q.popleft()
+        for u in preds[v]:
+            if dist[u] == np.inf:
+                dist[u] = dist[v] + 1
+                q.append(u)
+
+    # worst = never reaching goal within max_length; best = shortest path
+    reachable = [n for n in range(1, n_nodes) if np.isfinite(dist[n])]
+    bestlen = float(np.mean([min(dist[n] + 1, max_length) for n in reachable]))
+    worstlen = float(max_length)
+
+    def walk_length(sample: List[int]) -> int:
+        """Steps until the goal token appears (max_length if never)."""
+        for ix, tok in enumerate(sample):
+            if tok == goal:
+                return ix + 1
+        return max_length
+
+    def stats_fn(samples: List[List[int]]) -> dict:
+        actlen = float(np.mean([walk_length(s) for s in samples]))
+        pct = 100 * (worstlen - actlen) / max(worstlen - bestlen, 1e-9)
+        return {"percentage": pct, "mean_walk_length": actlen}
+
+    def reward_fn(samples: List[List[int]]) -> List[float]:
+        rewards = []
+        for s in samples:
+            s = list(s)
+            if goal in s:
+                rewards.append(-float(s.index(goal) + 1))
+            else:
+                rewards.append(-100.0)
+        return rewards
+
+    logit_mask = ~adj
+    return walks, logit_mask, stats_fn, reward_fn

@@ -111,8 +111,10 @@ def generate(
     Everything inside is static-shape; wrap in jit (or pjit via the trainer).
 
     `logit_mask`: optional [V] (or [B, V]) boolean array; False entries are
-    excluded from sampling at every step (the reference uses this for the
-    randomwalks graph-edge restriction, examples/ilql_randomwalks.py:72).
+    excluded from sampling at every step. For the reference's per-previous-
+    token edge restriction ([V, V], examples/ilql_randomwalks.py:72) use
+    `extras_fn`, which receives (h_normed [B, D], logits [B, V],
+    prev_token [B]) and returns adjusted logits.
     """
     B, P = prompt_tokens.shape
     G = config.gen_size
@@ -154,11 +156,11 @@ def generate(
 
     # -- decode scan ------------------------------------------------------
     def decode_body(carry, step):
-        cache, logits, h_prev_normed, finished, rng = carry
+        cache, logits, h_prev_normed, prev_tok, finished, rng = carry
         rng, key = jax.random.split(rng)
         step_logits = logits
         if extras_fn is not None:
-            step_logits = extras_fn(h_prev_normed, step_logits)
+            step_logits = extras_fn(h_prev_normed, step_logits, prev_tok)
         if logit_mask is not None:
             step_logits = jnp.where(logit_mask, step_logits, NEG_INF)
         if config.eos_token_id >= 0 and config.min_new_tokens > 0:
@@ -191,12 +193,16 @@ def generate(
         )
         h_normed = layer_norm(ln_f, h, spec.layer_norm_epsilon)
         next_logits = project_logits(embed, spec, h_normed)[:, 0]
-        carry = (cache, next_logits, h_normed[:, 0], finished, rng)
+        carry = (cache, next_logits, h_normed[:, 0], tok, finished, rng)
         return carry, (tok, logprob, emitted_mask)
 
     h0_normed = h_last[:, 0]
     finished0 = jnp.zeros((B,), bool)
-    carry0 = (cache, logits0, h0_normed, finished0, rng)
+    # last real prompt token per row (left padding aware)
+    last_prompt_tok = jnp.take_along_axis(
+        prompt_tokens, jnp.maximum(real_len - 1, 0)[:, None], axis=1
+    )[:, 0]
+    carry0 = (cache, logits0, h0_normed, last_prompt_tok, finished0, rng)
     _, (gen_tokens, gen_logprobs, gen_mask) = jax.lax.scan(
         decode_body, carry0, jnp.arange(G)
     )

@@ -1,0 +1,187 @@
+"""ILQL network: trunk + LM head + V head + (double) Q heads + frozen
+target-Q heads.
+
+Parity target: reference `CausalLMWithValueHeads`
+(trlx/model/nn/ilql_models.py:29-100). TPU-first differences:
+
+- Params are split {frozen_base, trainable, target}; the Polyak target sync
+  is a pure pytree interpolation (`sync_targets`) — no ZeRO gathered-params
+  machinery needed (reference ilql_models.py:201-214), since under SPMD the
+  params are already globally addressable.
+- All heads are applied to the post-ln_f hidden state in the same single
+  trunk forward (reference applies heads to `last_hidden_state`,
+  ilql_models.py:86-100).
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelSpec
+from trlx_tpu.models.heads import head_apply, init_head_params
+from trlx_tpu.models.policy import resolve_num_unfrozen
+from trlx_tpu.models.transformer import (
+    apply_blocks,
+    attention_scores,
+    causal_mask_bias,
+    embed_tokens,
+    init_block_params,
+    init_embed_params,
+    init_ln_f_params,
+    layer_norm,
+    positions_from_mask,
+    project_logits,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ILQLModel:
+    """Static description; methods are pure functions over the params tree."""
+
+    spec: ModelSpec
+    num_layers_unfrozen: int = -1
+    two_qs: bool = True
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Any = None
+
+    @property
+    def k(self) -> int:
+        return resolve_num_unfrozen(self.spec, self.num_layers_unfrozen)
+
+    def _attn(self):
+        return self.attention_fn or attention_scores
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        return _jitted_init(self, param_dtype)(rng)
+
+    def _init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        spec, k = self.spec, self.k
+        keys = jax.random.split(rng, 6)
+        embed = init_embed_params(keys[0], spec, param_dtype)
+        blocks = init_block_params(keys[1], spec, spec.n_layer, param_dtype)
+        bottom = jax.tree_util.tree_map(lambda x: x[: spec.n_layer - k], blocks)
+        top = jax.tree_util.tree_map(lambda x: x[spec.n_layer - k :], blocks)
+        d = spec.d_model
+
+        lm_head = embed.pop("lm_head", None)
+        q1 = init_head_params(keys[2], d, spec.vocab_size, param_dtype)
+        trainable: Params = {
+            "blocks": top,
+            "ln_f": init_ln_f_params(spec, param_dtype),
+            "v_head": init_head_params(keys[3], d, 1, param_dtype),
+            "q1_head": q1,
+        }
+        target: Params = {"q1_head": jax.tree_util.tree_map(jnp.copy, q1)}
+        if self.two_qs:
+            q2 = init_head_params(keys[4], d, spec.vocab_size, param_dtype)
+            trainable["q2_head"] = q2
+            target["q2_head"] = jax.tree_util.tree_map(jnp.copy, q2)
+        if lm_head is not None:
+            trainable["lm_head"] = lm_head
+        return {
+            "frozen_base": {"embed": embed, "blocks": bottom},
+            "trainable": trainable,
+            "target": target,
+        }
+
+    # -- forward ------------------------------------------------------------
+
+    def forward(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, Tuple, Tuple, jnp.ndarray]:
+        """Returns (logits [B,T,V], qs tuple, target_qs tuple, vs [B,T]).
+
+        Parity: reference ilql_models.py:86-100 (heads on the final hidden
+        state); target-Q outputs carry stop_gradient.
+        """
+        spec = self.spec
+        positions = positions_from_mask(attention_mask)
+        mask_bias = causal_mask_bias(attention_mask)
+        h = embed_tokens(
+            params["frozen_base"]["embed"], spec, tokens, positions,
+            self.compute_dtype,
+        )
+        h = apply_blocks(
+            params["frozen_base"]["blocks"], spec, h, mask_bias, positions,
+            remat=self.remat, attention_fn=self._attn(),
+        )
+        h = apply_blocks(
+            params["trainable"]["blocks"], spec, h, mask_bias, positions,
+            remat=self.remat, attention_fn=self._attn(),
+        )
+        h_normed = layer_norm(
+            params["trainable"]["ln_f"], h, spec.layer_norm_epsilon
+        )
+        head_params = dict(params["frozen_base"]["embed"])
+        if "lm_head" in params["trainable"]:
+            head_params["lm_head"] = params["trainable"]["lm_head"]
+        logits = project_logits(head_params, spec, h_normed)
+
+        qs = (head_apply(params["trainable"]["q1_head"], h_normed),)
+        target_qs = (
+            jax.lax.stop_gradient(
+                head_apply(params["target"]["q1_head"], h_normed)
+            ),
+        )
+        if self.two_qs:
+            qs = qs + (head_apply(params["trainable"]["q2_head"], h_normed),)
+            target_qs = target_qs + (
+                jax.lax.stop_gradient(
+                    head_apply(params["target"]["q2_head"], h_normed)
+                ),
+            )
+        vs = head_apply(params["trainable"]["v_head"], h_normed).squeeze(-1)
+        return logits, qs, target_qs, vs
+
+    def heads_on_hidden(self, params: Params, h_normed: jnp.ndarray):
+        """(min target Q [.., V], v [.., 1]) on a post-ln_f hidden state —
+        the decode-time pieces of the advantage-shifted sampler
+        (reference ilql_models.py:239-249 uses target Qs and V)."""
+        tq = head_apply(params["target"]["q1_head"], h_normed)
+        if self.two_qs:
+            tq = jnp.minimum(
+                tq, head_apply(params["target"]["q2_head"], h_normed)
+            )
+        v = head_apply(params["trainable"]["v_head"], h_normed)
+        return tq, v
+
+    def all_blocks(self, params: Params) -> Params:
+        bottom = params["frozen_base"]["blocks"]
+        top = params["trainable"]["blocks"]
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), bottom, top
+        )
+
+    def head_params_for_decode(self, params: Params):
+        embed = dict(params["frozen_base"]["embed"])
+        if "lm_head" in params["trainable"]:
+            embed["lm_head"] = params["trainable"]["lm_head"]
+        return embed, params["trainable"]["ln_f"]
+
+
+def sync_targets(params: Params, alpha: float) -> Params:
+    """Polyak update: target <- alpha * q + (1 - alpha) * target
+    (parity: reference ilql_models.py:185-199) as a pure pytree lerp."""
+    new_target = {}
+    for name, tgt in params["target"].items():
+        src = params["trainable"][name]
+        new_target[name] = jax.tree_util.tree_map(
+            lambda q, t: alpha * q + (1.0 - alpha) * t, src, tgt
+        )
+    return {**params, "target": new_target}
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_init(model: ILQLModel, param_dtype):
+    return jax.jit(lambda rng: model._init(rng, param_dtype))

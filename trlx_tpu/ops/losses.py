@@ -135,6 +135,73 @@ def ppo_losses(
     return loss, stats
 
 
+def ilql_losses(
+    logits: jnp.ndarray,
+    qs: Tuple[jnp.ndarray, ...],
+    target_qs: Tuple[jnp.ndarray, ...],
+    vs: jnp.ndarray,
+    tokens: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    rewards: jnp.ndarray,
+    gamma: float,
+    tau: float,
+    cql_scale: float,
+    awac_scale: float,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """The ILQL composite loss: Q TD loss + expectile V loss + CQL
+    cross-entropy + AWAC LM cross-entropy.
+
+    Parity: reference trlx/model/nn/ilql_models.py:102-183 exactly —
+    including the non-terminal mask semantics (`attention_mask[:, :-1]`,
+    with the final real position's mask zeroed upstream by the offline
+    orchestrator) and sum/n_nonterminal normalization.
+
+    Shapes: logits/qs/target_qs [B, T, V]; vs [B, T]; tokens/attention_mask
+    [B, T]; rewards [B, T-1].
+    """
+    actions = tokens[:, 1:]
+    nonterminal = attention_mask[:, :-1].astype(jnp.float32)
+    n_nonterminal = jnp.maximum(nonterminal.sum(), 1.0)
+
+    def gathered(q):
+        return jnp.take_along_axis(q[:, :-1], actions[..., None], axis=-1)[..., 0]
+
+    Qs = tuple(gathered(q) for q in qs)
+    targetQ = gathered(target_qs[0])
+    if len(target_qs) > 1:
+        targetQ = jnp.minimum(targetQ, gathered(target_qs[1]))
+    targetQ = jax.lax.stop_gradient(targetQ)
+
+    V_next = vs[:, 1:] * nonterminal
+    Q_ = jax.lax.stop_gradient(rewards + gamma * V_next)
+
+    loss_q = sum(
+        (((Q - Q_) * nonterminal) ** 2).sum() / n_nonterminal for Q in Qs
+    )
+
+    V = vs[:, 1:] * nonterminal
+    diff = targetQ - V
+    weight = jnp.where(targetQ >= V, tau, 1.0 - tau)
+    loss_v = (weight * diff**2 * nonterminal).sum() / n_nonterminal
+
+    def masked_ce(pred_logits):
+        lp = logprobs_from_logits(pred_logits[:, :-1], actions)
+        return (-(lp) * nonterminal).sum() / n_nonterminal
+
+    loss_cql = sum(masked_ce(q) for q in qs)
+    loss_awac = masked_ce(logits)
+
+    loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
+    stats = {
+        "loss": loss,
+        "loss_q": loss_q,
+        "loss_v": loss_v,
+        "loss_cql": loss_cql,
+        "loss_awac": loss_awac,
+    }
+    return loss, stats
+
+
 def kl_penalty_rewards(
     logprobs: jnp.ndarray,
     ref_logprobs: jnp.ndarray,

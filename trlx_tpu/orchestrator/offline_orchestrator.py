@@ -1,1 +1,63 @@
-"""Offline orchestrator — placeholder; lands with the ILQL stack milestone."""
+"""Offline orchestrator — one-shot ILQL dataset builder.
+
+Parity target: reference trlx/orchestrator/offline_orchestrator.py:10-41:
+tokenize train samples (if strings), build attention masks with the final
+position zeroed, compute whitened terminal returns from `reward_fn`, place
+each return on the last reward slot, and install train_store /
+eval_pipeline / reward_fn / stats_fn on the trainer.
+"""
+
+import numpy as np
+
+from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.pipeline.offline_pipeline import (
+    OfflinePipeline,
+    OfflineRolloutStorage,
+)
+
+
+@register_orchestrator("OfflineOrchestrator")
+class OfflineOrchestrator(Orchestrator):
+    def __init__(self, model, train_samples, eval_prompts, reward_fn,
+                 stats_fn=None):
+        self.model = model
+        self.rl_model = model
+
+        if isinstance(train_samples[0], str):
+            train_samples = model.tokenize(train_samples)["input_ids"]
+        train_samples = [list(map(int, row)) for row in train_samples]
+
+        # mask everything, except the terminal position is zeroed (the
+        # reference's convention: attention_mask[-1] = 0,
+        # offline_orchestrator.py:19-21 — the loss reads it as the
+        # non-terminal mask over state positions)
+        attention_mask = []
+        for row in train_samples:
+            m = np.ones(len(row), np.int32)
+            m[-1] = 0
+            attention_mask.append(m)
+
+        returns = np.asarray(reward_fn(train_samples), np.float32)
+        returns = (returns - returns.mean()) / (returns.std() + 1e-30)
+
+        rewards = []
+        for row, G in zip(train_samples, returns):
+            r = np.zeros(len(row) - 1, np.float32)
+            r[-1] = G
+            rewards.append(r)
+
+        model.train_store = OfflineRolloutStorage(
+            train_samples, attention_mask, rewards
+        )
+        model.store = model.train_store
+        model.eval_pipeline = OfflinePipeline(eval_prompts)
+        model.reward_fn = reward_fn
+        model.stats_fn = stats_fn
+
+    def score(self, samples):
+        return self.model.reward_fn(samples)
+
+    def make_experience(self, num_rollouts: int = 0, iter_count: int = 0):
+        """Offline: the dataset is built once in __init__ (parity with the
+        reference, which has no make_experience for ILQL)."""
+        return {"rollouts": len(self.model.train_store)}

@@ -1,1 +1,89 @@
-"""Offline (ILQL) pipeline — placeholder; lands with the ILQL stack milestone."""
+"""Offline (ILQL) pipeline and rollout storage.
+
+Parity target: reference trlx/pipeline/offline_pipeline.py:14-63.
+`OfflinePipeline` is the eval-prompt dataset (strings or pre-tokenized id
+rows); `OfflineRolloutStorage` holds (input_ids, attention_mask, rewards)
+triples and yields right-padded `ILQLBatch`es (the reference pads with
+eos via pad_sequence(batch_first=True)).
+"""
+
+from typing import Iterator, List
+
+import numpy as np
+
+from trlx_tpu.data.ilql_types import ILQLBatch, ILQLElement
+from trlx_tpu.pipeline import (
+    BasePipeline,
+    BaseRolloutStore,
+    batch_iterator,
+    register_datapipeline,
+)
+
+
+@register_datapipeline("OfflinePipeline")
+class OfflinePipeline(BasePipeline):
+    """Eval prompts: list of strings, or an array/list of token-id rows."""
+
+    def __init__(self, texts=None):
+        super().__init__()
+        self.texts = list(texts) if texts is not None else []
+
+    def __getitem__(self, index: int):
+        return self.texts[index]
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, seed: int = 0,
+        drop_last: bool = False,
+    ) -> Iterator:
+        return batch_iterator(
+            len(self),
+            batch_size,
+            shuffle,
+            seed,
+            lambda idx: [self.texts[i] for i in idx],
+            drop_last=drop_last,
+        )
+
+
+class OfflineRolloutStorage(BaseRolloutStore):
+    """Pre-tokenized offline samples (parity: reference
+    offline_pipeline.py:29-63)."""
+
+    def __init__(self, input_ids: List, attention_mask: List, rewards: List):
+        super().__init__()
+        self.input_ids = [np.asarray(x, np.int32) for x in input_ids]
+        self.attention_mask = [np.asarray(x, np.int32) for x in attention_mask]
+        self.rewards = [np.asarray(x, np.float32) for x in rewards]
+
+    def __getitem__(self, index: int) -> ILQLElement:
+        return ILQLElement(
+            self.input_ids[index],
+            self.attention_mask[index],
+            self.rewards[index],
+        )
+
+    def __len__(self) -> int:
+        return len(self.input_ids)
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, seed: int = 0,
+        eos_token_id: int = 0,
+    ) -> Iterator:
+        maxlen = max(len(x) for x in self.input_ids)
+
+        def fetch(idx):
+            ids = np.full((len(idx), maxlen), eos_token_id, np.int32)
+            mask = np.zeros((len(idx), maxlen), np.int32)
+            rewards = np.zeros((len(idx), maxlen - 1), np.float32)
+            for row, i in enumerate(idx):
+                n = len(self.input_ids[i])
+                ids[row, :n] = self.input_ids[i]
+                mask[row, :n] = self.attention_mask[i]
+                rewards[row, : n - 1] = self.rewards[i]
+            return ILQLBatch(ids, mask, rewards)
+
+        return batch_iterator(len(self), batch_size, shuffle, seed, fetch,
+                              drop_last=False)
