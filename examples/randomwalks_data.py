@@ -37,6 +37,11 @@ def generate_random_walks(
             adj[i, j if j < i else j + 1] = True
 
     goal = 0
+    # the goal is absorbing (reference: examples/ilql_randomwalks.py:31-33):
+    # its only edge is the self-loop, so the eval-time logit mask forces a
+    # walk that reaches the goal to stay there.
+    adj[goal, :] = False
+    adj[goal, goal] = True
 
     def walk_from(start: int) -> List[int]:
         node, path = start, [start]

@@ -214,8 +214,12 @@ def rw_config(n_nodes, epochs=20):
                 "gamma": 0.99,
                 "cql_scale": 0.1,
                 "awac_scale": 1.0,
-                "alpha": 0.005,
-                "steps_for_target_q_sync": 5,
+                # hard target copy every 10 steps — the reference's shipped
+                # hyperparameters (configs/ilql_config.yml:36-37); a small
+                # Polyak alpha here leaves the target heads (and hence the
+                # sampler's advantage shift) at their random init.
+                "alpha": 1.0,
+                "steps_for_target_q_sync": 10,
                 "beta": 4.0,
                 "two_qs": True,
             },

@@ -49,6 +49,30 @@ def test_gae_matches_reference_loop():
         np.testing.assert_allclose(np.asarray(ret), ret_np, rtol=1e-5, atol=1e-6)
 
 
+def test_gae_masked_ignores_pad_contamination():
+    """Post-eos pads (zero reward, arbitrary values) must not leak into the
+    advantages of real tokens: masked GAE over [B, T] must equal unmasked
+    GAE over the truncated real window."""
+    B, T, real = 2, 8, 5
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    rewards[:, real:] = 0.0  # pads carry no reward...
+    values[:, real:] = 100.0  # ...but arbitrary value-head outputs
+    mask = np.zeros((B, T), np.float32)
+    mask[:, :real] = 1.0
+
+    adv, ret = jax.jit(gae_advantages, static_argnums=(2, 3))(
+        jnp.asarray(values), jnp.asarray(rewards), 0.95, 0.9,
+        jnp.asarray(mask),
+    )
+    adv_ref, ret_ref = np_gae(values[:, :real], rewards[:, :real], 0.95, 0.9)
+    np.testing.assert_allclose(np.asarray(adv)[:, :real], adv_ref,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret)[:, :real], ret_ref,
+                               rtol=1e-5, atol=1e-6)
+    assert (np.asarray(adv)[:, real:] == 0).all()
+
+
 def test_whiten():
     x = rng.normal(loc=3.0, scale=2.0, size=(4, 9)).astype(np.float32)
     w = np.asarray(whiten(jnp.asarray(x)))
@@ -113,9 +137,9 @@ def test_ppo_losses_golden():
         logprobs, values, old_logprobs, old_values, advantages, returns,
         0.2, 0.2, 2.3,
     )
-    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
-    np.testing.assert_allclose(float(stats["pg_loss"]), pg, rtol=1e-5)
-    np.testing.assert_allclose(float(stats["vf_loss"]), vf, rtol=1e-5)
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+    np.testing.assert_allclose(float(stats["pg_loss"]), pg, rtol=1e-4)
+    np.testing.assert_allclose(float(stats["vf_loss"]), vf, rtol=1e-4)
 
 
 def test_kl_penalty_rewards():
@@ -130,7 +154,9 @@ def test_kl_penalty_rewards():
     expected = -0.2 * kls
     expected[:, -1] += scores
     np.testing.assert_allclose(np.asarray(rewards), expected, rtol=1e-5)
-    np.testing.assert_allclose(np.asarray(seq_kl), kls.mean(-1), rtol=1e-5)
+    # per-sequence SUM of KL — the quantity the reference feeds its adaptive
+    # controller (accelerate_ppo_model.py:130-135)
+    np.testing.assert_allclose(np.asarray(seq_kl), kls.sum(-1), rtol=1e-5)
 
 
 def test_kl_penalty_rewards_masked_places_score_on_last_real_token():

@@ -95,17 +95,23 @@ class ILQLConfig(MethodConfig):
     :param gamma: discount
     :param cql_scale: CQL (cross-entropy on Q) loss weight
     :param awac_scale: AWAC (LM cross-entropy) loss weight
-    :param alpha: Polyak coefficient for target-Q sync
+    :param alpha: Polyak coefficient for target-Q sync (the reference's
+        shipped config uses 1.0 — a hard copy every sync)
     :param steps_for_target_q_sync: sync period in optimizer steps
     :param beta: advantage temperature used at sampling time
     :param two_qs: use min(Q1, Q2) double-Q
+    :param top_k: sampler top-k (TPU extra; the reference hardcodes 20 in
+        its sampler signature, ilql_models.py:221)
+    :param temperature: sampler temperature (TPU extra; reference default 1)
     """
 
     tau: float = 0.7
     gamma: float = 0.99
     cql_scale: float = 0.1
     awac_scale: float = 1.0
-    alpha: float = 0.005
-    steps_for_target_q_sync: int = 1
+    alpha: float = 1.0
+    steps_for_target_q_sync: int = 10
     beta: float = 4.0
     two_qs: bool = True
+    top_k: int = 20
+    temperature: float = 1.0

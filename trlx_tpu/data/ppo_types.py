@@ -34,6 +34,11 @@ class PPORLElement:
         after eos, [response_size]. The reference has no equivalent because
         it only ever generates fixed-length responses; with eos termination
         active, losses/KL must exclude pad positions.
+    :param query_mask: the prompt attention mask the rollout actually used,
+        [query_size]. Stored rather than reconstructed from pad ids at
+        train time: with eos-as-pad tokenizers (gpt2) a legitimate eos
+        inside a prompt is indistinguishable from padding, and the
+        train-time forward must attend exactly what generation attended.
     """
 
     query_tensor: np.ndarray
@@ -42,6 +47,7 @@ class PPORLElement:
     values: np.ndarray
     rewards: np.ndarray
     response_mask: np.ndarray = None
+    query_mask: np.ndarray = None
 
 
 @register_batch_pytree
@@ -55,6 +61,7 @@ class PPORLBatch:
     :param values: [batch, response_size]
     :param rewards: [batch, response_size]
     :param response_masks: [batch, response_size]
+    :param query_masks: [batch, query_size]
     """
 
     query_tensors: np.ndarray
@@ -63,16 +70,31 @@ class PPORLBatch:
     values: np.ndarray
     rewards: np.ndarray
     response_masks: np.ndarray
+    query_masks: np.ndarray
 
     def __len__(self) -> int:
         return int(self.query_tensors.shape[0])
 
     @classmethod
     def stack(cls, elements) -> "PPORLBatch":
-        def mask_of(e):
+        def resp_mask_of(e):
+            # all-ones is safe here: it means "every generated token is
+            # real", the reference's fixed-length-generation semantics
             if e.response_mask is not None:
                 return e.response_mask
             return np.ones_like(e.response_tensor, dtype=np.int32)
+
+        def query_mask_of(e):
+            # no safe fallback: prompts are normally LEFT-padded, and the
+            # pad id is tokenizer state this container doesn't have, so an
+            # all-ones guess would attend pad tokens the rollout masked
+            if e.query_mask is None:
+                raise ValueError(
+                    "PPORLElement.query_mask is required to stack a batch: "
+                    "store the prompt attention mask the rollout used "
+                    "(left-padded prompts make it non-trivial)."
+                )
+            return e.query_mask
 
         return cls(
             query_tensors=np.stack([e.query_tensor for e in elements]),
@@ -80,7 +102,8 @@ class PPORLBatch:
             logprobs=np.stack([e.logprobs for e in elements]),
             values=np.stack([e.values for e in elements]),
             rewards=np.stack([e.rewards for e in elements]),
-            response_masks=np.stack([mask_of(e) for e in elements]),
+            response_masks=np.stack([resp_mask_of(e) for e in elements]),
+            query_masks=np.stack([query_mask_of(e) for e in elements]),
         )
 
     def unstack(self):
@@ -92,6 +115,7 @@ class PPORLBatch:
                 self.values[i],
                 self.rewards[i],
                 self.response_masks[i],
+                self.query_masks[i],
             )
             for i in range(len(self))
         ]

@@ -32,7 +32,6 @@ from trlx_tpu.models.transformer import (
     init_embed_params,
     init_ln_f_params,
     layer_norm,
-    positions_from_mask,
     project_logits,
 )
 
@@ -104,9 +103,18 @@ class ILQLModel:
 
         Parity: reference ilql_models.py:86-100 (heads on the final hidden
         state); target-Q outputs carry stop_gradient.
+
+        Positions are plain arange (broadcast over the batch): the reference
+        HF trunk uses arange position ids regardless of the attention mask,
+        and ILQL data is right-padded with the terminal position's mask
+        zeroed (offline_orchestrator.py:19-21) — deriving positions from
+        that mask would give the terminal token a duplicate position id and
+        shift its hidden state (and hence V at the bootstrap target) away
+        from the reference's.
         """
         spec = self.spec
-        positions = positions_from_mask(attention_mask)
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         mask_bias = causal_mask_bias(attention_mask)
         h = embed_tokens(
             params["frozen_base"]["embed"], spec, tokens, positions,

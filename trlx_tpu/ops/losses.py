@@ -60,6 +60,7 @@ def gae_advantages(
     rewards: jnp.ndarray,
     gamma: float,
     lam: float,
+    mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Generalized advantage estimation over the response window.
 
@@ -68,12 +69,25 @@ def gae_advantages(
     reference's reverse loop (accelerate_ppo_model.py:68-84) with V_{T} = 0
     beyond the last token.
 
+    `mask` (1 = real response token): the reference never needs one (its
+    configs pin fixed-length generation), but with eos termination active the
+    post-eos pad slots carry zero reward yet arbitrary value-head outputs.
+    The episode is treated as ending at the last real token: the bootstrap
+    value V_{t+1} is zeroed when t+1 is a pad, and pad deltas are zeroed so
+    nothing propagates backward through the scan into real tokens.
+
     Implemented as a reverse `lax.scan` — O(T) sequential but fully fused,
     no Python loop in the trace.
     """
     B, T = values.shape
     v_next = jnp.concatenate([values[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1)
-    deltas = rewards + gamma * v_next - values  # [B, T]
+    if mask is not None:
+        m = mask.astype(values.dtype)
+        m_next = jnp.concatenate([m[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1)
+        v_next = v_next * m_next
+        deltas = (rewards + gamma * v_next - values) * m
+    else:
+        deltas = rewards + gamma * v_next - values  # [B, T]
 
     def step(carry, delta_t):
         adv = delta_t + gamma * lam * carry
@@ -214,7 +228,13 @@ def kl_penalty_rewards(
     trlx/orchestrator/ppo_orchestrator.py:89-92).
 
     logprobs/ref_logprobs: [B, T]; scores: [B]; returns (rewards [B, T],
-    mean per-sequence KL [B]).
+    per-sequence summed KL [B]).
+
+    `seq_kl` is the per-sequence SUM of per-token KL over real tokens — the
+    quantity the reference feeds its adaptive KL controller
+    (accelerate_ppo_model.py:130-135 updates with mean over the batch of
+    sum(kl, -1)); its YAML `target` (e.g. 6 over ~48 tokens) is calibrated
+    for that sum, not a per-token mean.
     """
     kls = logprobs - ref_logprobs
     if mask is not None:
@@ -222,10 +242,9 @@ def kl_penalty_rewards(
     rewards = -kl_coef * kls
     if mask is None:
         rewards = rewards.at[:, -1].add(scores)
-        seq_kl = kls.mean(axis=-1)
     else:
         # index of last real token per row
         last = jnp.maximum(mask.sum(axis=-1).astype(jnp.int32) - 1, 0)
         rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
-        seq_kl = masked_mean(kls, mask, axis=-1)
+    seq_kl = kls.sum(axis=-1)
     return rewards, seq_kl

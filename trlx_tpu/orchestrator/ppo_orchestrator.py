@@ -111,6 +111,7 @@ class PPOOrchestrator(Orchestrator):
                 values=values,
                 rewards=rewards,
                 response_masks=gen_mask,
+                query_masks=np.asarray(qmask, np.int32),
             )
             trainer.push_to_store(batch)
             self.clock.tick(len(texts))

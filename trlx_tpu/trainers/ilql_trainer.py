@@ -127,6 +127,8 @@ class JaxILQLTrainer(BaseRLTrainer):
             return params, opt_state, stats
 
         beta = m.beta
+        top_k = m.top_k
+        temperature = m.temperature
         logit_mask = self.logit_mask
 
         def generate_fn(params, query, query_mask, rng, gen_config):
@@ -136,18 +138,21 @@ class JaxILQLTrainer(BaseRLTrainer):
             def extras(h_normed, logits, prev_tok):
                 """pi~ = softmax(topk(log pi + beta * (minQ_target - V))
                 / temp) (reference ilql_models.py:246-252), plus the
-                per-prev-token edge mask of randomwalks."""
-                tq, v = net.heads_on_hidden(params, h_normed)
-                adv = tq - v
-                pi = jax.nn.log_softmax(logits, axis=-1)
-                shifted = warp_top_k(pi + beta * adv, self._sample_top_k)
+                per-prev-token edge mask of randomwalks. The mask is
+                applied BEFORE log_softmax, as the reference does
+                (ilql_models.py:246-247): pi renormalizes over allowed
+                tokens, and top-k never selects a disallowed token."""
                 if logit_mask is not None:
                     if logit_mask.ndim == 2:
                         disallowed = logit_mask[prev_tok]
                     else:
                         disallowed = logit_mask[None, :]
-                    shifted = jnp.where(disallowed, -1e9, shifted)
-                return shifted / self._sample_temperature
+                    logits = jnp.where(disallowed, -1e9, logits)
+                tq, v = net.heads_on_hidden(params, h_normed)
+                adv = tq - v
+                pi = jax.nn.log_softmax(logits, axis=-1)
+                shifted = warp_top_k(pi + beta * adv, top_k)
+                return shifted / temperature
 
             return generate(
                 net.spec, blocks, embed, ln_f, query, query_mask, rng,
@@ -160,9 +165,6 @@ class JaxILQLTrainer(BaseRLTrainer):
         self._generate_jitted = {}
 
     # -- sampling --------------------------------------------------------- #
-
-    _sample_top_k = 20
-    _sample_temperature = 1.0
 
     def next_rng(self):
         self._rng, key = jax.random.split(self._rng)

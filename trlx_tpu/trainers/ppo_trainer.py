@@ -193,20 +193,20 @@ class JaxPPOTrainer(BaseRLTrainer):
             old_values = batch.values
             resp_mask = batch.response_masks
             advantages, returns = gae_advantages(
-                old_values, batch.rewards, m.gamma, m.lam
+                old_values, batch.rewards, m.gamma, m.lam, mask=resp_mask
             )
             advantages = jax.lax.stop_gradient(
                 whiten(advantages, mask=resp_mask)
             )
 
             tokens = jnp.concatenate([query, response], axis=1)
-            pad = gen_config.pad_token_id
-            qmask = (query != pad).astype(jnp.int32)
-            # attention matches what generation attended (pads included —
-            # the reference's unmasked forward does the same,
-            # ppo_orchestrator.py:71); only the LOSSES exclude pads.
+            # attention matches what generation attended (the rollout's own
+            # prompt mask, response pads included — the reference's unmasked
+            # forward does the same, ppo_orchestrator.py:71); only the
+            # LOSSES exclude pads.
             mask = jnp.concatenate(
-                [qmask, jnp.ones(response.shape, jnp.int32)], axis=1
+                [batch.query_masks, jnp.ones(response.shape, jnp.int32)],
+                axis=1,
             )
 
             def loss_fn(trainable):
