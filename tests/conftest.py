@@ -16,6 +16,10 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 
 import jax  # noqa: E402
 
+# The build image force-registers the TPU platform plugin ahead of the env
+# var (jax_platforms ends up "axon,cpu"); pin the config itself so tests
+# really run on the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 # JAX's DEFAULT matmul precision on CPU downcasts to bf16-like accuracy;

@@ -1,0 +1,95 @@
+"""Device-mesh construction from config.
+
+The mesh replaces the reference's process-group bootstrap
+(`Accelerator()` + `torch.distributed.barrier`, reference:
+trlx/model/accelerate_base_model.py:52-57): axis sizes come from
+`TrainConfig.mesh` (e.g. ``{"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}``), one
+axis may be -1 meaning "all remaining devices", and the resulting
+`jax.sharding.Mesh` is the single object every sharding in the framework
+hangs off.
+
+Axis order matters for ICI locality: tp (highest-bandwidth, innermost) is
+last so tensor-parallel collectives ride neighbouring chips, then sp, fsdp,
+dp outermost — the standard TPU layout (dp may cross DCN on multi-slice
+topologies, tp must not).
+"""
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Outer-to-inner axis order; see module docstring.
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+def resolve_axis_sizes(
+    mesh_config: Optional[Dict[str, int]], n_devices: int
+) -> Dict[str, int]:
+    """Fill in -1 ("all remaining devices") and validate divisibility."""
+    sizes = {ax: 1 for ax in AXES}
+    if mesh_config:
+        unknown = set(mesh_config) - set(AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {sorted(unknown)}; valid axes: {AXES}"
+            )
+        sizes.update({ax: int(v) for ax, v in mesh_config.items()})
+
+    wildcards = [ax for ax, v in sizes.items() if v == -1]
+    if len(wildcards) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {wildcards}")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wildcards:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"fixed mesh axes use {fixed} devices which does not divide "
+                f"the {n_devices} available"
+            )
+        sizes[wildcards[0]] = n_devices // fixed
+    elif fixed != n_devices:
+        raise ValueError(
+            f"mesh axes {sizes} require {fixed} devices but {n_devices} are "
+            f"available; set one axis to -1 to absorb the remainder"
+        )
+    return sizes
+
+
+def build_mesh(
+    mesh_config: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over all (or the given) devices.
+
+    `mesh_config` maps axis name -> size; missing axes default to 1 and one
+    axis may be -1. With no config at all, every device goes to `dp`.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if mesh_config is None:
+        mesh_config = {"dp": -1}
+    sizes = resolve_axis_sizes(mesh_config, n)
+    shape = tuple(sizes[ax] for ax in AXES)
+    if devices is jax.devices() or list(devices) == list(jax.devices()):
+        dev_array = mesh_utils.create_device_mesh(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def mesh_from_config(train_config) -> Optional[Mesh]:
+    """Mesh from `TrainConfig.mesh`, or None when unset (single-device
+    eager placement — small models, unit tests)."""
+    if getattr(train_config, "mesh", None) is None:
+        return None
+    return build_mesh(train_config.mesh)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1x1x1x1 mesh on the first device — lets sharded code paths run
+    unchanged on one chip."""
+    return build_mesh({}, devices=jax.devices()[:1])

@@ -1,0 +1,59 @@
+"""Multi-host runtime bootstrap.
+
+Replaces the reference's launcher/process model — `accelerate launch`, one
+process per GPU, WORLD_SIZE/LOCAL_RANK env plumbing, NCCL process groups
+(reference: README.md:125, trlx/model/accelerate_base_model.py:21-22,54-55):
+
+On TPU pods the model is one process per *host*, each seeing its slice's
+local chips; `jax.distributed.initialize()` wires the hosts together and
+every `jax.devices()` call then returns the global device list. Collectives
+need no further setup — they are compiled into the SPMD program.
+
+`initialize_runtime()` is safe to call unconditionally: it no-ops on single
+-process environments (CPU tests, the one-chip bench) and is idempotent.
+"""
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def initialize_runtime(coordinator_address: str = None,
+                       num_processes: int = None,
+                       process_id: int = None) -> None:
+    """Initialize multi-host JAX when running on more than one process.
+
+    With no arguments, relies on the TPU pod metadata that
+    `jax.distributed.initialize` auto-discovers; explicit arguments support
+    manual rigs. No-op (with a note in the env) when single-process.
+    """
+    global _initialized
+    if _initialized:
+        return
+    explicit = coordinator_address is not None
+    auto_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS"
+    )
+    if explicit or auto_pod:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_main_process() -> bool:
+    """Metrics/checkpoint emission gate (parity: the reference's
+    `accelerator.is_main_process`, trlx/model/accelerate_base_model.py:58)."""
+    return jax.process_index() == 0

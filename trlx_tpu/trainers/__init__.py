@@ -33,10 +33,37 @@ class BaseRLTrainer:
     rollout/train store, and jitted step functions.
     """
 
-    def __init__(self, config, train_mode: bool = True):
+    def __init__(self, config, train_mode: bool = True, mesh=None):
+        from trlx_tpu.parallel import mesh_from_config
+
         self.config = config
         self.train_mode = train_mode
         self.store = None
+        # mesh: explicit > config (TrainConfig.mesh) > None (single device)
+        self.mesh = mesh if mesh is not None else mesh_from_config(config.train)
+
+    # -- SPMD helpers (shared by all trainers) --------------------------- #
+
+    def _shard_model_state(self, params, opt):
+        """(sharded params, sharded opt state) under the framework specs
+        when a mesh is active; pass-through otherwise."""
+        from trlx_tpu.parallel import shard_params, sharded_opt_init
+
+        if self.mesh is not None:
+            params = shard_params(self.mesh, params)
+        return params, sharded_opt_init(opt, self.mesh, params["trainable"])
+
+    def _put(self, tree):
+        """Host batch -> device: sharded over (dp, fsdp) when a mesh is
+        active, plain transfer otherwise."""
+        import jax
+        import jax.numpy as jnp
+
+        from trlx_tpu.parallel import shard_batch
+
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, tree)
+        return shard_batch(self.mesh, tree)
 
     def push_to_store(self, data) -> None:
         """Append experience to the rollout store

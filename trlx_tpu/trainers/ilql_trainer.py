@@ -42,8 +42,7 @@ DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float
 class JaxILQLTrainer(BaseRLTrainer):
     def __init__(self, config: TRLConfig, train_mode: bool = True,
                  logit_mask=None, mesh=None):
-        super().__init__(config, train_mode)
-        self.mesh = mesh
+        super().__init__(config, train_mode, mesh=mesh)
         self.iter_count = 0
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)
         self.max_length = config.train.gen_size
@@ -71,7 +70,9 @@ class JaxILQLTrainer(BaseRLTrainer):
             optax.clip_by_global_norm(config.train.grad_clip),
             optax.adamw(sched, weight_decay=config.train.weight_decay),
         )
-        self.opt_state = self.opt.init(self.params["trainable"])
+        self.params, self.opt_state = self._shard_model_state(
+            self.params, self.opt
+        )
 
         # [V] or [V, V] boolean; True = DISALLOWED (the reference passes the
         # adjacency complement, examples/ilql_randomwalks.py:72)
@@ -186,9 +187,10 @@ class JaxILQLTrainer(BaseRLTrainer):
             self._generate_jitted[key] = jax.jit(
                 lambda p, q, m, r: self._generate_fn(p, q, m, r, gen_config)
             )
+        query, mask = self._put((np.asarray(query_tokens),
+                                 np.asarray(query_mask)))
         return self._generate_jitted[key](
-            self.params, jnp.asarray(query_tokens), jnp.asarray(query_mask),
-            self.next_rng(),
+            self.params, query, mask, self.next_rng()
         )
 
     def act(self, batch):
@@ -287,7 +289,7 @@ class JaxILQLTrainer(BaseRLTrainer):
                     if ev:
                         log_fn({"iter": self.iter_count, **ev})
 
-                jbatch = jax.tree_util.tree_map(jnp.asarray, batch)
+                jbatch = self._put(batch)
                 self.params, self.opt_state, stats = self._train_step(
                     self.params, self.opt_state, jbatch
                 )

@@ -73,8 +73,7 @@ class JaxPPOTrainer(BaseRLTrainer):
     ppo_orchestrator.py:41-43)."""
 
     def __init__(self, config: TRLConfig, train_mode: bool = True, mesh=None):
-        super().__init__(config, train_mode)
-        self.mesh = mesh
+        super().__init__(config, train_mode, mesh=mesh)
         self.rollout_clock = Clock()
         self.iter_count = 0
         self.epoch = 0
@@ -99,7 +98,9 @@ class JaxPPOTrainer(BaseRLTrainer):
 
         # --- optimizer -----------------------------------------------------
         self.opt = build_optimizer(config.train)
-        self.opt_state = self.opt.init(self.params["trainable"])
+        self.params, self.opt_state = self._shard_model_state(
+            self.params, self.opt
+        )
 
         # --- rollout machinery --------------------------------------------
         self.store = PPORolloutStorage()
@@ -244,10 +245,9 @@ class JaxPPOTrainer(BaseRLTrainer):
         return key
 
     def generate(self, query_tokens, query_mask):
-        return self._generate_fn(
-            self.params, jnp.asarray(query_tokens), jnp.asarray(query_mask),
-            self.next_rng(),
-        )
+        query, mask = self._put((np.asarray(query_tokens),
+                                 np.asarray(query_mask)))
+        return self._generate_fn(self.params, query, mask, self.next_rng())
 
     def act(self, batch):
         """Generate responses for a prompt batch; returns (query, response,
@@ -275,12 +275,14 @@ class JaxPPOTrainer(BaseRLTrainer):
                          scores):
         """Device scoring for the orchestrator; returns numpy
         (logprobs, values, rewards, mean_kl)."""
+        seqs, attn, rmask, sc = self._put((
+            np.asarray(sequences),
+            np.asarray(attention_mask),
+            np.asarray(response_mask),
+            np.asarray(scores, np.float32),
+        ))
         logprobs, vals, rewards, seq_kl = self._score_fn(
-            self.params,
-            jnp.asarray(sequences),
-            jnp.asarray(attention_mask),
-            jnp.asarray(response_mask),
-            jnp.asarray(scores, dtype=jnp.float32),
+            self.params, seqs, attn, rmask, sc,
             jnp.float32(self.kl_ctl.value),
             self.config.train.input_size,
         )
@@ -355,7 +357,7 @@ class JaxPPOTrainer(BaseRLTrainer):
                 cfg.batch_size, shuffle=True, seed=self.epoch
             )
             for batch in loader:
-                batch = jax.tree_util.tree_map(jnp.asarray, batch)
+                batch = self._put(batch)
                 stats = None
                 for _ in range(m.ppo_epochs):
                     self.params, self.opt_state, stats = self._train_step(
