@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Benchmark: the reference's primary workload (ppo_sentiments, gpt2-124M)
+on one real TPU chip.
+
+Workload shape mirrors the reference's shipped config exactly
+(reference: configs/ppo_config.yml): batch 128, 4 prompt + 48 generated
+tokens, 128 rollouts per outer epoch, 4 ppo_epochs, num_layers_unfrozen 2,
+fixed-length sampling. Weights are from-config (no network egress for the
+HF checkpoint); throughput is weight-value independent. The reward callback
+is a host-side function, as the reference's distilbert pipeline is.
+
+Measures, per the reference's own instrumentation points
+(trlx/orchestrator/ppo_orchestrator.py:100-105, trlx/utils/__init__.py:50-88):
+
+- ppo samples/sec over a full rollout+update cycle (the headline),
+- decode tokens/sec of the jitted KV-cache generation,
+- train-step time and model-flops MFU,
+- exp_time (sec per rollout chunk), matching the reference metric name.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline compares
+against the previous round's BENCH_r*.json value when present, else 1.0.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+
+import numpy as np
+
+# bf16 peak matmul throughput per chip, by TPU generation
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12 / 2,  # 197 TOPS int8 => ~98.5 TFLOP/s bf16
+    "v5p": 459e12,
+    "v6e": 918e12 / 2,
+}
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build():
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": "gpt2-from-config",
+                "tokenizer_path": "byte",
+                "model_type": "JaxPPOTrainer",
+                "num_layers_unfrozen": 2,  # reference ppo_config.yml:6
+                "model_spec": {  # gpt2-124M geometry
+                    "vocab_size": 50257,
+                    "n_layer": 12,
+                    "n_head": 12,
+                    "d_model": 768,
+                    "n_positions": 1024,
+                },
+                "compute_dtype": "bfloat16",
+            },
+            "train": {
+                "n_ctx": 512,
+                "epochs": 1,
+                "total_steps": 4,
+                "batch_size": 128,
+                "grad_clip": 1.0,
+                "lr_ramp_steps": 100,
+                "lr_decay_steps": 79000,
+                "weight_decay": 1.0e-6,
+                "learning_rate_init": 1.412e-4,
+                "learning_rate_target": 1.412e-4,
+                "log_interval": 10**9,
+                "checkpoint_interval": 10**9,
+                "eval_interval": 10**9,
+                "pipeline": "PPOPipeline",
+                "orchestrator": "PPOOrchestrator",
+                "input_size": 4,
+                "gen_size": 48,
+                "seed": 0,
+            },
+            "method": {
+                "name": "ppoconfig",
+                "num_rollouts": 128,
+                "chunk_size": 128,
+                "ppo_epochs": 4,
+                "init_kl_coef": 0.2,
+                "target": 6,
+                "horizon": 10000,
+                "gamma": 1,
+                "lam": 0.95,
+                "cliprange": 0.2,
+                "cliprange_value": 0.2,
+                "vf_coef": 2.3,
+                "gen_kwargs": {
+                    "max_length": 48,
+                    "min_length": 48,
+                    "top_k": 0,
+                    "top_p": 1.0,
+                    "do_sample": True,
+                },
+            },
+        }
+    )
+
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        "".join(chr(c) for c in rng.integers(97, 123, size=16))
+        for _ in range(256)
+    ]
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, trainer.tokenizer, config
+    )
+
+    def reward_fn(texts):  # host callback, like the reference's HF pipeline
+        return [float(np.mean([c.islower() for c in t] or [0.0])) for t in texts]
+
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    return config, trainer, pipeline, orch
+
+
+def model_flops_per_train_token(spec, num_layers_unfrozen):
+    """Matmul flops per (batch x seq) token of one PPO optimization step.
+
+    Forward runs the full depth; backward only reaches the trainable top
+    (gradients stop at the frozen-trunk boundary — the hydra split).
+    Attention quadratic terms are excluded (T=52 makes them negligible
+    against d_model=768 projections); this slightly UNDERSTATES flops, so
+    MFU is conservative.
+    """
+    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+    per_layer = 2 * (4 * d * d + 2 * d * f)  # qkv+o projections, mlp in/out
+    fwd = L * per_layer + 2 * d * V  # + logits projection
+    k = num_layers_unfrozen if num_layers_unfrozen >= 0 else L
+    bwd = 2 * (k * per_layer + 2 * d * V)
+    return fwd + bwd
+
+
+def decode_flops_per_token(spec):
+    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+    return L * 2 * (4 * d * d + 2 * d * f) + 2 * d * V
+
+
+def previous_round_value(metric):
+    """Best previous BENCH_r*.json value for vs_baseline, if any."""
+    best = None
+    for path in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            data = json.load(open(path))
+        except Exception:
+            continue
+        parsed = data.get("parsed") if isinstance(data, dict) else None
+        if isinstance(parsed, dict) and parsed.get("metric") == metric:
+            v = parsed.get("value")
+            if isinstance(v, (int, float)):
+                best = v
+    return best
+
+
+def main():
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    peak = PEAK_FLOPS.get(gen)
+    log(f"devices: {devices} (platform={platform}, gen={gen or 'unknown'})")
+
+    config, trainer, pipeline, orch = build()
+    m = config.method
+    B = m.chunk_size
+    G = config.train.gen_size
+    spec = trainer.policy.spec
+
+    # ---- warmup: compile generate / score / train_step -------------------
+    t0 = time.perf_counter()
+    orch.make_experience(m.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    jax.block_until_ready(trainer.params["trainable"])
+    log(f"warmup (compile included): {time.perf_counter() - t0:.1f}s")
+
+    # ---- decode tokens/sec ----------------------------------------------
+    query, qmask = next(iter(pipeline.create_loader(B)))
+    out = trainer.generate(query, qmask)  # warm cache for this shape
+    jax.block_until_ready(out.sequences)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = trainer.generate(query, qmask)
+    jax.block_until_ready(out.sequences)
+    dt = (time.perf_counter() - t0) / reps
+    decode_tok_s = B * G / dt
+    decode_mfu = (
+        decode_flops_per_token(spec) * decode_tok_s / peak if peak else None
+    )
+    log(f"decode: {decode_tok_s:,.0f} tok/s ({dt*1e3:.1f} ms per [{B},{G}] "
+        f"batch){f', MFU {decode_mfu:.1%}' if decode_mfu else ''}")
+
+    # ---- train-step time + MFU ------------------------------------------
+    batch = next(iter(trainer.store.create_loader(config.train.batch_size)))
+    batch = trainer._put(batch)
+    trainer.params, trainer.opt_state, _ = trainer._train_step(
+        trainer.params, trainer.opt_state, batch
+    )  # warm
+    jax.block_until_ready(trainer.params["trainable"])
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        trainer.params, trainer.opt_state, stats = trainer._train_step(
+            trainer.params, trainer.opt_state, batch
+        )
+    jax.block_until_ready(trainer.params["trainable"])
+    step_dt = (time.perf_counter() - t0) / reps
+    tokens_per_step = config.train.batch_size * (config.train.input_size + G)
+    train_flops = model_flops_per_train_token(
+        spec, config.model.num_layers_unfrozen
+    ) * tokens_per_step
+    train_mfu = train_flops / step_dt / peak if peak else None
+    log(f"train_step: {step_dt*1e3:.1f} ms "
+        f"({tokens_per_step/step_dt:,.0f} tok/s)"
+        f"{f', MFU {train_mfu:.1%}' if train_mfu else ''}")
+
+    # ---- full rollout+update cycles (the headline) -----------------------
+    cycles = 3
+    per_cycle = []
+    exp_times = []
+    for i in range(cycles):
+        trainer.store.clear_history()
+        trainer.iter_count = 0
+        trainer.epoch = 0
+        t0 = time.perf_counter()
+        info = orch.make_experience(m.num_rollouts)
+        t_exp = time.perf_counter() - t0
+        trainer.learn(log_fn=lambda s: None)
+        jax.block_until_ready(trainer.params["trainable"])
+        dt = time.perf_counter() - t0
+        per_cycle.append(dt)
+        exp_times.append(t_exp)
+        log(f"cycle {i}: {dt:.2f}s total (exp_time {t_exp:.2f}s, "
+            f"update {dt - t_exp:.2f}s)")
+    best = min(per_cycle)
+    samples_per_sec = m.num_rollouts / best
+
+    metric = "ppo_rollout_update_samples_per_sec"
+    prev = previous_round_value(metric)
+    result = {
+        "metric": metric,
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(samples_per_sec / prev, 3) if prev else 1.0,
+        "workload": "ppo_sentiments gpt2-124M b128 4+48tok (ref ppo_config.yml)",
+        "platform": f"{platform}:{gen or 'unknown'}",
+        "decode_tokens_per_sec": round(decode_tok_s, 1),
+        "train_step_ms": round(step_dt * 1e3, 2),
+        "train_mfu": round(train_mfu, 4) if train_mfu else None,
+        "decode_mfu": round(decode_mfu, 4) if decode_mfu else None,
+        "exp_time_sec": round(min(exp_times), 3),
+        "update_time_sec": round(best - min(exp_times), 3),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

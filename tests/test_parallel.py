@@ -10,14 +10,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
 from trlx_tpu.parallel import (
     build_mesh,
     param_sharding_specs,
     shard_batch,
-    shard_params,
 )
 from trlx_tpu.parallel.mesh import resolve_axis_sizes
 from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
@@ -164,6 +163,17 @@ def test_sharded_generation_runs_and_matches_shapes(devices):
     query, mask = next(iter(pipeline.create_loader(8)))
     out = meshed.generate(query, mask)
     assert out.sequences.shape == (8, 4 + 8)
+    assert np.isfinite(np.asarray(out.gen_logprobs)).all()
+
+
+def test_generation_pads_odd_batch_on_mesh(devices):
+    """Ad-hoc batch sizes (eval prompts, user sample calls) that don't
+    divide dp*fsdp are padded to shard, then sliced back."""
+    config, meshed = _tiny_trainer({"dp": 2, "fsdp": 2, "tp": 2})
+    query = np.full((6, 4), 97, np.int32)
+    mask = np.ones((6, 4), np.int32)
+    out = meshed.generate(query, mask)
+    assert out.sequences.shape[0] == 6
     assert np.isfinite(np.asarray(out.gen_logprobs)).all()
 
 

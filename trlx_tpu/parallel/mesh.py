@@ -38,6 +38,12 @@ def resolve_axis_sizes(
                 f"unknown mesh axes {sorted(unknown)}; valid axes: {AXES}"
             )
         sizes.update({ax: int(v) for ax, v in mesh_config.items()})
+    bad = {ax: v for ax, v in sizes.items() if v < 1 and v != -1}
+    if bad:
+        raise ValueError(
+            f"mesh axis sizes must be positive (or -1 for 'all remaining "
+            f"devices'), got {bad}"
+        )
 
     wildcards = [ax for ax, v in sizes.items() if v == -1]
     if len(wildcards) > 1:

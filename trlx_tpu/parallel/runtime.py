@@ -33,9 +33,12 @@ def initialize_runtime(coordinator_address: str = None,
     if _initialized:
         return
     explicit = coordinator_address is not None
-    auto_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-        "MEGASCALE_COORDINATOR_ADDRESS"
-    )
+    # TPU_WORKER_HOSTNAMES lists the pod's hosts; single-host runtimes set
+    # it to "localhost", so only a multi-entry list means a real pod.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    auto_pod = ("," in hostnames) or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ) or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
     if explicit or auto_pod:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -70,7 +70,7 @@ class OfflineRolloutStorage(BaseRolloutStore):
 
     def create_loader(
         self, batch_size: int, shuffle: bool = False, seed: int = 0,
-        eos_token_id: int = 0,
+        eos_token_id: int = 0, drop_last: bool = False,
     ) -> Iterator:
         maxlen = max(len(x) for x in self.input_ids)
 
@@ -86,4 +86,4 @@ class OfflineRolloutStorage(BaseRolloutStore):
             return ILQLBatch(ids, mask, rewards)
 
         return batch_iterator(len(self), batch_size, shuffle, seed, fetch,
-                              drop_last=False)
+                              drop_last=drop_last)

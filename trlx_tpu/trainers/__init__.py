@@ -34,11 +34,14 @@ class BaseRLTrainer:
     """
 
     def __init__(self, config, train_mode: bool = True, mesh=None):
-        from trlx_tpu.parallel import mesh_from_config
+        from trlx_tpu.parallel import initialize_runtime, mesh_from_config
 
         self.config = config
         self.train_mode = train_mode
         self.store = None
+        # multi-host bootstrap first (no-op single-process), so the mesh
+        # sees the pod's global device list
+        initialize_runtime()
         # mesh: explicit > config (TrainConfig.mesh) > None (single device)
         self.mesh = mesh if mesh is not None else mesh_from_config(config.train)
 
@@ -64,6 +67,32 @@ class BaseRLTrainer:
         if self.mesh is None:
             return jax.tree_util.tree_map(jnp.asarray, tree)
         return shard_batch(self.mesh, tree)
+
+    def _pad_rows(self, tree):
+        """(padded tree, real row count): repeat the final row until the
+        batch dim is a multiple of dp*fsdp. Covers ad-hoc batch sizes (eval
+        prompts, user sample() calls) that the mesh couldn't shard; callers
+        slice results back to the real count."""
+        import jax
+        import numpy as np
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        n = leaves[0].shape[0]
+        if self.mesh is None:
+            return tree, n
+        n_data = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        pad = (-n) % n_data
+        if pad == 0:
+            return tree, n
+        return (
+            jax.tree_util.tree_map(
+                lambda x: np.concatenate(
+                    [x, np.repeat(np.asarray(x)[-1:], pad, axis=0)], axis=0
+                ),
+                tree,
+            ),
+            n,
+        )
 
     def push_to_store(self, data) -> None:
         """Append experience to the rollout store
@@ -93,11 +122,26 @@ class BaseRLTrainer:
         (parity: reference model/__init__.py:90-99)."""
         raise NotImplementedError
 
+    def _main_process_log(self, log_fn: Callable) -> Callable:
+        """Emit metrics from process 0 only (parity: the reference's
+        main-process-only tracker init + accelerator.print,
+        accelerate_base_model.py:58-61)."""
+        from trlx_tpu.parallel import is_main_process
+
+        if log_fn is None or is_main_process():
+            return log_fn
+        return lambda stats: None
+
     def save(self, directory: str = None) -> None:
         """Checkpoint components (reference's torch.save per component →
-        Orbax here; see trlx_tpu.utils.checkpoint)."""
+        Orbax here; see trlx_tpu.utils.checkpoint). Single-writer: only
+        process 0 writes (params are replicated or re-shardable on
+        restore)."""
+        from trlx_tpu.parallel import is_main_process
         from trlx_tpu.utils.checkpoint import save_components
 
+        if not is_main_process():
+            return
         save_components(self.get_components(), directory or self.config.train.checkpoint_dir)
 
     def load(self, directory: str = None) -> None:

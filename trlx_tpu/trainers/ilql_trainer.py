@@ -187,11 +187,16 @@ class JaxILQLTrainer(BaseRLTrainer):
             self._generate_jitted[key] = jax.jit(
                 lambda p, q, m, r: self._generate_fn(p, q, m, r, gen_config)
             )
-        query, mask = self._put((np.asarray(query_tokens),
-                                 np.asarray(query_mask)))
-        return self._generate_jitted[key](
+        (query, mask), n = self._pad_rows(
+            (np.asarray(query_tokens), np.asarray(query_mask))
+        )
+        query, mask = self._put((query, mask))
+        out = self._generate_jitted[key](
             self.params, query, mask, self.next_rng()
         )
+        if n != query.shape[0]:
+            out = jax.tree_util.tree_map(lambda x: x[:n], out)
+        return out
 
     def act(self, batch):
         query, mask = batch
@@ -271,17 +276,19 @@ class JaxILQLTrainer(BaseRLTrainer):
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
         cfg = self.config.train
         m = self.config.method
-        log_fn = log_fn or (lambda s: print(
+        log_fn = self._main_process_log(log_fn or (lambda s: print(
             {k: (round(v, 5) if isinstance(v, float) else v)
              for k, v in s.items() if np.isscalar(v) or isinstance(v, (int, float))},
             flush=True,
-        ))
+        )))
         clock = Clock()
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
 
         for epoch in range(cfg.epochs):
             loader = self.train_store.create_loader(
-                cfg.batch_size, shuffle=True, seed=epoch, eos_token_id=eos
+                cfg.batch_size, shuffle=True, seed=epoch, eos_token_id=eos,
+                # a partial final batch can't shard over (dp, fsdp)
+                drop_last=self.mesh is not None,
             )
             for batch in loader:
                 if self.iter_count % cfg.eval_interval == 0:

@@ -245,9 +245,14 @@ class JaxPPOTrainer(BaseRLTrainer):
         return key
 
     def generate(self, query_tokens, query_mask):
-        query, mask = self._put((np.asarray(query_tokens),
-                                 np.asarray(query_mask)))
-        return self._generate_fn(self.params, query, mask, self.next_rng())
+        (query, mask), n = self._pad_rows(
+            (np.asarray(query_tokens), np.asarray(query_mask))
+        )
+        query, mask = self._put((query, mask))
+        out = self._generate_fn(self.params, query, mask, self.next_rng())
+        if n != query.shape[0]:
+            out = jax.tree_util.tree_map(lambda x: x[:n], out)
+        return out
 
     def act(self, batch):
         """Generate responses for a prompt batch; returns (query, response,
@@ -349,7 +354,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         periodic eval between batches, fresh experience each outer epoch."""
         cfg = self.config.train
         m = self.config.method
-        log_fn = log_fn or _default_logger
+        log_fn = self._main_process_log(log_fn or _default_logger)
         clock = Clock()
 
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
