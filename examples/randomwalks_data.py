@@ -28,20 +28,41 @@ def generate_random_walks(
     `~adj` convention (examples/ilql_randomwalks.py:72).
     """
     rng = np.random.default_rng(seed)
-    adj = rng.random((n_nodes, n_nodes)) < p_edge
-    np.fill_diagonal(adj, False)
-    # every node needs at least one outgoing edge
-    for i in range(n_nodes):
-        if not adj[i].any():
-            j = int(rng.integers(0, n_nodes - 1))
-            adj[i, j if j < i else j + 1] = True
-
     goal = 0
-    # the goal is absorbing (reference: examples/ilql_randomwalks.py:31-33):
-    # its only edge is the self-loop, so the eval-time logit mask forces a
-    # walk that reaches the goal to stay there.
-    adj[goal, :] = False
-    adj[goal, goal] = True
+
+    def bfs_dist(adj):
+        """Shortest-path steps to the goal over edges u -> v."""
+        dist = np.full(n_nodes, np.inf)
+        dist[goal] = 0
+        q = deque([goal])
+        preds = [np.flatnonzero(adj[:, v]) for v in range(n_nodes)]
+        while q:
+            v = q.popleft()
+            for u in preds[v]:
+                if dist[u] == np.inf:
+                    dist[u] = dist[v] + 1
+                    q.append(u)
+        return dist
+
+    # Regenerate until every node has an outgoing edge AND every node can
+    # reach the goal (the reference only retries on the first condition,
+    # examples/ilql_randomwalks.py:24-28; requiring reachability too makes
+    # every seed a well-posed task).
+    for _ in range(1000):
+        adj = rng.random((n_nodes, n_nodes)) < p_edge
+        np.fill_diagonal(adj, False)
+        if not adj.sum(1).all():
+            continue
+        # the goal is absorbing (reference: examples/ilql_randomwalks.py:31-33):
+        # its only edge is the self-loop, so the eval-time logit mask forces
+        # a walk that reaches the goal to stay there.
+        adj[goal, :] = False
+        adj[goal, goal] = True
+        dist = bfs_dist(adj)
+        if np.isfinite(dist[1:]).all():
+            break
+    else:
+        raise RuntimeError("could not generate a solvable graph")
 
     def walk_from(start: int) -> List[int]:
         node, path = start, [start]
@@ -54,22 +75,11 @@ def generate_random_walks(
 
     walks = [walk_from(int(rng.integers(1, n_nodes))) for _ in range(n_walks)]
 
-    # BFS shortest path to goal from every node (for the optimality metric)
-    dist = np.full(n_nodes, np.inf)
-    dist[goal] = 0
-    q = deque([goal])
-    # reverse-edge BFS: dist[u] over edges u -> v
-    preds = [np.flatnonzero(adj[:, v]) for v in range(n_nodes)]
-    while q:
-        v = q.popleft()
-        for u in preds[v]:
-            if dist[u] == np.inf:
-                dist[u] = dist[v] + 1
-                q.append(u)
-
     # worst = never reaching goal within max_length; best = shortest path
-    reachable = [n for n in range(1, n_nodes) if np.isfinite(dist[n])]
-    bestlen = float(np.mean([min(dist[n] + 1, max_length) for n in reachable]))
+    # (dist from the generation loop above — every node is reachable)
+    bestlen = float(
+        np.mean([min(dist[n] + 1, max_length) for n in range(1, n_nodes)])
+    )
     worstlen = float(max_length)
 
     def walk_length(sample: List[int]) -> int:

@@ -136,3 +136,27 @@ def test_tpu_extra_fields_defaults():
     assert cfg.model.model_spec["n_layer"] == 2
     spec = ModelSpec.from_dict(cfg.model.model_spec)
     assert spec.head_dim == 16
+
+
+def test_shipped_configs_load_and_registries_resolve():
+    """The repo's own configs/ directory must parse and every component
+    name must resolve through the registries (the reference ships
+    configs/*.yml the same way)."""
+    from pathlib import Path
+
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+
+    cfg_dir = Path(__file__).resolve().parent.parent / "configs"
+    names = sorted(p.name for p in cfg_dir.glob("*.yml"))
+    assert {"ppo_config.yml", "ilql_config.yml", "ppo_gptj.yml",
+            "test_config.yml"} <= set(names)
+    for name in names:
+        cfg = TRLConfig.load_yaml(str(cfg_dir / name))
+        assert get_model(cfg.model.model_type) is not None
+        assert get_pipeline(cfg.train.pipeline) is not None
+        assert get_orchestrator(cfg.train.orchestrator) is not None
+        if cfg.train.mesh is not None:
+            from trlx_tpu.parallel.mesh import resolve_axis_sizes
+
+            # mesh axes must be resolvable on an 8-device pod slice
+            resolve_axis_sizes(cfg.train.mesh, 8)

@@ -265,6 +265,51 @@ def load_trunk_from_hf(model_path: str, local_files_only: Optional[bool] = None)
     raise last_err
 
 
+def ilql_params_from_trunk(
+    net, embed: Params, blocks: Params, ln_f: Params, rng
+) -> Params:
+    """Assemble the ILQL param split from an imported trunk: bottom frozen,
+    top trainable, fresh V/Q heads, target = copy of Q heads (parity:
+    reference CausalLMWithValueHeads loads the HF trunk then attaches heads,
+    trlx/model/nn/ilql_models.py:32-84)."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.heads import init_head_params
+
+    spec, k = net.spec, net.k
+    keys = jax.random.split(rng, 3)
+    as_jnp = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+    bottom = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x[: spec.n_layer - k]), blocks
+    )
+    top = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x[spec.n_layer - k :]), blocks
+    )
+    embed = dict(as_jnp(embed))
+    lm_head = embed.pop("lm_head", None)
+
+    q1 = init_head_params(keys[0], spec.d_model, spec.vocab_size)
+    trainable: Params = {
+        "blocks": top,
+        "ln_f": as_jnp(ln_f),
+        "v_head": init_head_params(keys[1], spec.d_model, 1),
+        "q1_head": q1,
+    }
+    target: Params = {"q1_head": jax.tree_util.tree_map(jnp.copy, q1)}
+    if net.two_qs:
+        q2 = init_head_params(keys[2], spec.d_model, spec.vocab_size)
+        trainable["q2_head"] = q2
+        target["q2_head"] = jax.tree_util.tree_map(jnp.copy, q2)
+    if lm_head is not None:
+        trainable["lm_head"] = lm_head
+    return {
+        "frozen_base": {"embed": embed, "blocks": bottom},
+        "trainable": trainable,
+        "target": target,
+    }
+
+
 def hydra_params_from_trunk(
     policy, embed: Params, blocks: Params, ln_f: Params, rng
 ) -> Params:

@@ -122,6 +122,31 @@ class BaseRLTrainer:
         (parity: reference model/__init__.py:90-99)."""
         raise NotImplementedError
 
+    def _load_or_spec(self, config):
+        """(spec, trunk | None): pretrained import when no explicit
+        model_spec is configured; a from-config random init otherwise.
+
+        A failing pretrained load RAISES instead of silently training a
+        from-scratch model — a typo'd model_path must not masquerade as a
+        successful run. Opt into random init explicitly via
+        `model.model_spec`."""
+        if config.model.model_spec is not None:
+            return config.model.resolve_spec(), None
+        from trlx_tpu.models.hf_import import load_trunk_from_hf
+
+        try:
+            spec, embed, blocks, ln_f = load_trunk_from_hf(
+                config.model.model_path
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"could not load pretrained weights for "
+                f"'{config.model.model_path}': {e!r}. For a from-config "
+                f"randomly-initialized model, set model.model_spec in the "
+                f"config instead."
+            ) from e
+        return spec, (embed, blocks, ln_f)
+
     def _main_process_log(self, log_fn: Callable) -> Callable:
         """Emit metrics from process 0 only (parity: the reference's
         main-process-only tracker init + accelerator.print,

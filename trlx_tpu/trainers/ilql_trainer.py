@@ -27,6 +27,7 @@ import optax
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ilql_types import ILQLBatch
 from trlx_tpu.models.generation import GenerationConfig, generate
+from trlx_tpu.models.hf_import import ilql_params_from_trunk
 from trlx_tpu.models.ilql import ILQLModel as ILQLNet, sync_targets
 from trlx_tpu.ops.losses import ilql_losses
 from trlx_tpu.ops.sampling import SamplingParams, warp_top_k
@@ -50,7 +51,7 @@ class JaxILQLTrainer(BaseRLTrainer):
         m = config.method
         rng = jax.random.PRNGKey(config.train.seed)
         self._rng, init_rng = jax.random.split(rng)
-        spec = config.model.resolve_spec()
+        spec, trunk = self._load_or_spec(config)
         self.net = ILQLNet(
             spec=spec,
             num_layers_unfrozen=config.model.num_layers_unfrozen,
@@ -58,7 +59,10 @@ class JaxILQLTrainer(BaseRLTrainer):
             compute_dtype=DTYPES[config.model.compute_dtype],
             remat=config.train.remat,
         )
-        self.params = self.net.init(init_rng)
+        if trunk is not None:
+            self.params = ilql_params_from_trunk(self.net, *trunk, init_rng)
+        else:
+            self.params = self.net.init(init_rng)
 
         sched = rampup_decay_schedule(
             config.train.lr_ramp_steps,

@@ -30,7 +30,7 @@ import optax
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLBatch
 from trlx_tpu.models.generation import GenerationConfig, generate
-from trlx_tpu.models.hf_import import hydra_params_from_trunk, load_trunk_from_hf
+from trlx_tpu.models.hf_import import hydra_params_from_trunk
 from trlx_tpu.models.policy import HydraPolicy
 from trlx_tpu.ops.losses import (
     gae_advantages,
@@ -121,17 +121,6 @@ class JaxPPOTrainer(BaseRLTrainer):
         self._build_jitted_fns()
 
     # ------------------------------------------------------------------ #
-
-    def _load_or_spec(self, config: TRLConfig):
-        """Pretrained import when the checkpoint is reachable; otherwise a
-        from-config random init (offline environments, tiny test models)."""
-        if config.model.model_spec is not None:
-            return config.model.resolve_spec(), None
-        try:
-            spec, embed, blocks, ln_f = load_trunk_from_hf(config.model.model_path)
-            return spec, (embed, blocks, ln_f)
-        except Exception:
-            return config.model.resolve_spec(), None
 
     def set_orchestrator(self, orch, reward_fn: Callable) -> None:
         self.orch = orch
