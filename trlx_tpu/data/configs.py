@@ -157,6 +157,9 @@ class TrainConfig:
     accelerate_config_path: str = ""
 
     project_name: str = ""
+    # metric sink: "print" (default), "wandb", "jsonl:<path>", "none"
+    # (reference: Accelerator(log_with="wandb"), accelerate_base_model.py:52)
+    tracker: str = "print"
 
     mesh: Optional[Dict[str, int]] = None
     seed: int = 0

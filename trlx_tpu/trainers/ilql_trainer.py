@@ -34,6 +34,7 @@ from trlx_tpu.ops.sampling import SamplingParams, warp_top_k
 from trlx_tpu.trainers import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import Clock, rampup_decay_schedule
 from trlx_tpu.utils.tokenizer import load_tokenizer
+from trlx_tpu.utils.trackers import make_tracker, samples_table
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
@@ -266,13 +267,20 @@ class JaxILQLTrainer(BaseRLTrainer):
         samples = self.sample(prompts)
         sample_lists = [list(map(int, row)) for row in samples]
         logs = {}
+        decoded = None
+        if len(prompts) and isinstance(prompts[0], str):
+            decoded = self.tokenizer.batch_decode(samples)
         if self.reward_fn is not None:
-            if len(prompts) and isinstance(prompts[0], str):
-                decoded = self.tokenizer.batch_decode(samples)
-                rewards = np.asarray(self.reward_fn(decoded), np.float32)
-            else:
-                rewards = np.asarray(self.reward_fn(sample_lists), np.float32)
+            rewards = np.asarray(
+                self.reward_fn(decoded if decoded is not None
+                               else sample_lists),
+                np.float32,
+            )
             logs["reward"] = float(rewards.mean())
+            if decoded is not None:
+                # first-128 samples table (reference:
+                # accelerate_ilql_model.py:128-157)
+                logs["samples_table"] = samples_table(decoded, rewards)
         if self.stats_fn is not None:
             logs.update(self.stats_fn(sample_lists))
         return logs
@@ -280,11 +288,7 @@ class JaxILQLTrainer(BaseRLTrainer):
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
         cfg = self.config.train
         m = self.config.method
-        log_fn = self._main_process_log(log_fn or (lambda s: print(
-            {k: (round(v, 5) if isinstance(v, float) else v)
-             for k, v in s.items() if np.isscalar(v) or isinstance(v, (int, float))},
-            flush=True,
-        )))
+        log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         clock = Clock()
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
 

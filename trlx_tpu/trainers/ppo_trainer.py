@@ -44,6 +44,7 @@ from trlx_tpu.trainers import BaseRLTrainer, register_trainer
 from trlx_tpu.trainers.kl_controllers import make_kl_controller
 from trlx_tpu.utils import Clock, cosine_schedule
 from trlx_tpu.utils.tokenizer import load_tokenizer
+from trlx_tpu.utils.trackers import generations_table, make_tracker
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
@@ -331,9 +332,20 @@ class JaxPPOTrainer(BaseRLTrainer):
             np.asarray(out.sequences), skip_special_tokens=True
         )
         scores = np.asarray(self.reward_fn(texts), np.float32)
+        query_texts = self.tokenizer.batch_decode(
+            np.asarray(query), skip_special_tokens=True
+        )
+        response_texts = self.tokenizer.batch_decode(
+            np.asarray(out.gen_tokens), skip_special_tokens=True
+        )
         return {
             "mean_score": float(scores.mean()),
             "samples": texts[:4],
+            # decoded query/response/score rows (reference:
+            # accelerate_ppo_model.py:147-161)
+            "generations_table": generations_table(
+                query_texts, response_texts, scores
+            ),
         }
 
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
@@ -343,7 +355,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         periodic eval between batches, fresh experience each outer epoch."""
         cfg = self.config.train
         m = self.config.method
-        log_fn = self._main_process_log(log_fn or _default_logger)
+        log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         clock = Clock()
 
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
@@ -394,10 +406,3 @@ class JaxPPOTrainer(BaseRLTrainer):
         self.kl_ctl.update(mean_kl, n_samples)
 
 
-def _default_logger(stats: Dict) -> None:
-    printable = {
-        k: (round(v, 5) if isinstance(v, float) else v)
-        for k, v in stats.items()
-        if not isinstance(v, (list, tuple))
-    }
-    print(printable, flush=True)

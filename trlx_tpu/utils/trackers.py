@@ -1,0 +1,198 @@
+"""Experiment trackers: wandb (optional) with a print fallback.
+
+Parity target: the reference's tracker stack — `Accelerator(log_with=
+"wandb")` + `init_trackers(project_name, config)` on the main process only
+(reference: trlx/model/accelerate_base_model.py:52-61,
+trlx/model/accelerate_ilql_model.py:50-53), the PPO eval generations table
+(accelerate_ppo_model.py:147-161) and the ILQL samples table
+(accelerate_ilql_model.py:128-157).
+
+Design: a tracker is a callable taking one flat stats dict per emission —
+the same signature trainers already use for `log_fn` — so user-supplied
+log functions, the print fallback, and wandb are interchangeable. Keys
+ending in ``_table`` hold ``{"columns": [...], "rows": [[...], ...]}``
+and are routed to rich-table logging (wandb.Table) or compact text.
+The step is read from the ``iter`` key when present.
+"""
+
+import importlib
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _split(stats: Dict[str, Any]):
+    """(scalars, tables): route `*_table` dict values to table logging."""
+    scalars, tables = {}, {}
+    for k, v in stats.items():
+        if k.endswith("_table") and isinstance(v, dict) and "rows" in v:
+            tables[k] = v
+        else:
+            scalars[k] = v
+    return scalars, tables
+
+
+class PrintTracker:
+    """Default sink: one line per emission, tables as truncated text.
+
+    Mirrors the reference's `accelerator.print` stdout path
+    (accelerate_base_model.py:88)."""
+
+    def __init__(self, max_table_rows: int = 4):
+        self.max_table_rows = max_table_rows
+
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        scalars, tables = _split(stats)
+        printable = {
+            k: (round(v, 5) if isinstance(v, float) else v)
+            for k, v in scalars.items()
+            if not isinstance(v, (list, tuple, dict))
+        }
+        print(printable, flush=True)
+        for name, tbl in tables.items():
+            cols = tbl.get("columns", [])
+            print(f"-- {name} {cols}", flush=True)
+            for row in tbl["rows"][: self.max_table_rows]:
+                cells = [
+                    (c if len(c) <= 64 else c[:61] + "...")
+                    if isinstance(c, str)
+                    else c
+                    for c in row
+                ]
+                print(f"   {cells}", flush=True)
+
+    def finish(self) -> None:
+        pass
+
+
+class WandbTracker:
+    """wandb sink with the reference's init semantics: project from
+    `TrainConfig.project_name`, full config dict attached
+    (accelerate_base_model.py:58-61). Import is lazy and optional —
+    construction raises ImportError if wandb is unavailable; callers use
+    `make_tracker` to fall back to print."""
+
+    def __init__(self, project_name: str, config_dict: Optional[Dict] = None,
+                 **init_kwargs):
+        self._wandb = importlib.import_module("wandb")
+        self.run = self._wandb.init(
+            project=project_name or None, config=config_dict, **init_kwargs
+        )
+
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        scalars, tables = _split(stats)
+        step = scalars.get("iter")
+        payload = {
+            k: v for k, v in scalars.items()
+            if not isinstance(v, (list, tuple, dict))
+        }
+        for name, tbl in tables.items():
+            payload[name] = self._wandb.Table(
+                columns=list(tbl.get("columns", [])),
+                rows=[list(r) for r in tbl["rows"]],
+            )
+        self._wandb.log(payload, step=int(step) if step is not None else None)
+
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class JsonlTracker:
+    """Append-only JSONL sink for offline runs / tests."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        def default(o):
+            try:
+                return float(o)
+            except (TypeError, ValueError):
+                return str(o)
+
+        with open(self.path, "a") as f:
+            f.write(json.dumps(stats, default=default) + "\n")
+
+    def finish(self) -> None:
+        pass
+
+
+class MultiTracker:
+    def __init__(self, *trackers):
+        self.trackers = [t for t in trackers if t is not None]
+
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        for t in self.trackers:
+            t(stats)
+
+    def finish(self) -> None:
+        for t in self.trackers:
+            t.finish()
+
+
+def make_tracker(config=None, kind: Optional[str] = None):
+    """Build the configured tracker, main-process aware.
+
+    `kind` (or `config.train.tracker`): "wandb", "print", "none"/None, or a
+    "jsonl:<path>" spec. "wandb" degrades to print with a notice when the
+    package is missing or init fails (e.g. no network) — a missing tracker
+    must never kill a training run. Non-main processes always get a no-op
+    (parity: main-process-only tracker init,
+    accelerate_base_model.py:58-61)."""
+    from trlx_tpu.parallel import is_main_process
+
+    if not is_main_process():
+        return _NULL
+
+    kind = kind if kind is not None else getattr(
+        getattr(config, "train", None), "tracker", "print"
+    )
+    if kind in (None, "none", ""):
+        return _NULL
+    if isinstance(kind, str) and kind.startswith("jsonl:"):
+        return JsonlTracker(kind.split(":", 1)[1])
+    if kind == "wandb":
+        project = getattr(getattr(config, "train", None), "project_name", "")
+        cfg_dict = config.to_dict() if hasattr(config, "to_dict") else None
+        try:
+            return WandbTracker(project, cfg_dict)
+        except Exception as e:  # missing package, offline, auth failure
+            print(f"[trlx_tpu] wandb tracker unavailable ({e!r}); "
+                  f"falling back to stdout", flush=True)
+            return PrintTracker()
+    return PrintTracker()
+
+
+class _NullTracker:
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+_NULL = _NullTracker()
+
+
+def generations_table(queries: List[str], responses: List[str],
+                      scores) -> Dict[str, Any]:
+    """The PPO eval table: decoded query / response / score rows
+    (reference: accelerate_ppo_model.py:147-161)."""
+    return {
+        "columns": ["query", "response", "score"],
+        "rows": [
+            [q, r, float(s)] for q, r, s in zip(queries, responses, scores)
+        ],
+    }
+
+
+def samples_table(samples: List[str], rewards=None,
+                  max_rows: int = 128) -> Dict[str, Any]:
+    """The ILQL eval table: sampled text (+ reward when scored), first 128
+    rows (reference: accelerate_ilql_model.py:128-157)."""
+    if rewards is None:
+        rows = [[s] for s in samples[:max_rows]]
+        return {"columns": ["sample"], "rows": rows}
+    rows = [
+        [s, float(r)] for s, r in zip(samples[:max_rows], rewards)
+    ]
+    return {"columns": ["sample", "reward"], "rows": rows}
