@@ -197,3 +197,30 @@ def test_masked_mean():
     x = jnp.asarray(np.array([[1.0, 2.0, 100.0]], np.float32))
     m = jnp.asarray(np.array([[1, 1, 0]], np.float32))
     assert float(masked_mean(x, m)) == 1.5
+
+
+def test_ilql_losses_finite_with_out_of_vocab_pad():
+    """Regression: loaders may pad with an id >= model vocab (byte pad 256
+    on a 21-token graph model). Padded positions are masked, but an
+    unclipped gather fills NaN and NaN * 0 = NaN poisoned every loss term
+    (found via examples/ilql_randomwalks.py going NaN from step 1)."""
+    from trlx_tpu.ops.losses import ilql_losses
+
+    rng = np.random.default_rng(0)
+    B, T, V = 4, 6, 21
+    logits = jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32))
+    qs = (jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32)),)
+    tqs = (jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32)),)
+    vs = jnp.asarray(rng.normal(size=(B, T)).astype(np.float32))
+    tokens = np.full((B, T), 256, np.int32)  # pad id way out of vocab
+    tokens[:, :3] = rng.integers(0, V, size=(B, 3))
+    mask = np.zeros((B, T), np.int32)
+    mask[:, :2] = 1  # only the first transitions are real
+    loss, stats = ilql_losses(
+        jnp.asarray(logits), qs, tqs, vs, jnp.asarray(tokens),
+        jnp.asarray(mask), jnp.zeros((B, T - 1), np.float32),
+        0.99, 0.7, 0.1, 1.0,
+    )
+    assert np.isfinite(float(loss)), stats
+    for k, v in stats.items():
+        assert np.isfinite(float(v)), (k, v)

@@ -1,0 +1,192 @@
+"""Ring attention (sequence parallelism over the sp mesh axis).
+
+Validates the shard_map/ppermute ring against the dense XLA attention path
+on the 8-virtual-device CPU mesh: values, gradients, padding handling, and
+the full hydra-policy trunk with sp x tp composed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelSpec
+from trlx_tpu.models.policy import HydraPolicy
+from trlx_tpu.models.transformer import attention_scores, causal_mask_bias
+from trlx_tpu.ops.ring_attention import make_sp_attention_fn, ring_attention
+from trlx_tpu.parallel import build_mesh
+
+
+def _rand_qkv(rng, B, T, H, hd, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, T, H, hd), dtype)
+    k = jax.random.normal(kk, (B, T, H, hd), dtype)
+    v = jax.random.normal(kv, (B, T, H, hd), dtype)
+    return q, k, v
+
+
+def _dense_reference(q, k, v, mask, causal=True):
+    bias = causal_mask_bias(mask)
+    if not causal:
+        # padding-only bias
+        allowed = (mask[:, None, :] > 0) & jnp.ones(
+            (mask.shape[1], mask.shape[1]), bool
+        )[None]
+        bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[:, None]
+    return attention_scores(q, k, v, bias)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(devices, sp):
+    mesh = build_mesh({"dp": -1, "sp": sp})
+    B, T, H, hd = 2, 32, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), B, T, H, hd)
+    mask = jnp.ones((B, T), jnp.int32)
+
+    out = ring_attention(q, k, v, mask, mesh)
+    ref = _dense_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_with_left_padding(devices):
+    mesh = build_mesh({"dp": 2, "sp": 4})
+    B, T, H, hd = 4, 16, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), B, T, H, hd)
+    # left padding of varying lengths, like the rollout prompt layout
+    mask = np.ones((B, T), np.int32)
+    for i, pad in enumerate([0, 3, 7, 11]):
+        mask[i, :pad] = 0
+    mask = jnp.asarray(mask)
+
+    out = ring_attention(q, k, v, mask, mesh)
+    ref = _dense_reference(q, k, v, mask)
+    # compare only real-token query rows; padded-query rows are garbage-in
+    # in both paths but normalized differently (dense softmax over all -inf
+    # gives uniform probs, the streamed softmax an equivalent mix)
+    real = np.asarray(mask, bool)
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=1e-5
+    )
+
+
+def test_ring_non_causal(devices):
+    mesh = build_mesh({"dp": -1, "sp": 4})
+    B, T, H, hd = 2, 16, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), B, T, H, hd)
+    mask = jnp.ones((B, T), jnp.int32)
+    out = ring_attention(q, k, v, mask, mesh, causal=False)
+    ref = _dense_reference(q, k, v, mask, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_gradients_match_dense(devices):
+    mesh = build_mesh({"dp": -1, "sp": 4})
+    B, T, H, hd = 2, 16, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), B, T, H, hd)
+    mask = jnp.ones((B, T), jnp.int32)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mask, mesh) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense_reference(q, k, v, mask) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=1e-4)
+
+
+def test_ring_rejects_indivisible_seq(devices):
+    mesh = build_mesh({"dp": -1, "sp": 4})
+    q = jnp.zeros((1, 6, 2, 8))
+    mask = jnp.ones((1, 6), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, q, q, mask, mesh)
+
+
+def test_policy_forward_with_sp_matches_dense(devices):
+    """Full hydra trunk under ring attention (sp=2 composed with tp=2, dp=2)
+    matches the plain single-path forward — the long-context training path."""
+    mesh = build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    spec = ModelSpec(
+        arch="gpt2", vocab_size=64, n_layer=2, n_head=4, d_model=32,
+        n_positions=32,
+    )
+    dense_policy = HydraPolicy(
+        spec=spec, num_layers_unfrozen=1, compute_dtype=jnp.float32
+    )
+    sp_policy = HydraPolicy(
+        spec=spec,
+        num_layers_unfrozen=1,
+        compute_dtype=jnp.float32,
+        attention_fn=make_sp_attention_fn(mesh),
+    )
+    params = dense_policy.init(jax.random.PRNGKey(0))
+    B, T = 4, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 64)
+    mask = jnp.ones((B, T), jnp.int32)
+
+    with mesh:
+        logits_sp, ref_sp, values_sp = jax.jit(
+            lambda p, t, m: sp_policy.forward(p, t, m)
+        )(params, tokens, mask)
+    logits, ref, values = dense_policy.forward(params, tokens, mask)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_sp), np.asarray(logits), atol=2e-4
+    )
+    np.testing.assert_allclose(np.asarray(ref_sp), np.asarray(ref), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(values_sp), np.asarray(values), atol=2e-4
+    )
+
+
+def test_ppo_e2e_with_sp_axis(devices):
+    """Full PPO rollout->train loop with the trainer auto-selecting ring
+    attention from mesh sp=2 (composed with dp=2, tp=2). Train-time
+    sequence length is input_size + gen_size = 12, divisible by sp."""
+    from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = make_config(
+        total_steps=2, epochs=1, num_rollouts=16, chunk_size=16,
+        batch_size=16, ppo_epochs=1,
+    )
+    config.train.mesh = {"dp": 2, "sp": 2, "tp": 2}
+    config.train.log_interval = 1
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    assert trainer.policy.attention_fn is not None  # ring attention selected
+
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert np.isfinite(info["mean_score"])
+    logs = []
+    trainer.learn(log_fn=logs.append)
+    assert trainer.iter_count > 0
+    train_logs = [l for l in logs if "loss" in l]
+    assert train_logs and np.isfinite(train_logs[-1]["loss"])
+
+
+def test_ring_memory_shape_is_blockwise(devices):
+    """The jaxpr of the ring path must not contain a [B, H, T, T] dense
+    score tensor — only [B, H, T/sp, T/sp] blocks (the memory claim)."""
+    mesh = build_mesh({"dp": -1, "sp": 8})
+    B, T, H, hd = 1, 64, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), B, T, H, hd)
+    mask = jnp.ones((B, T), jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: ring_attention(q, k, v, mask, mesh)
+    )(q, k, v)
+    dense_score_shape = f"{B},{H},{T},{T}"
+    assert dense_score_shape not in str(jaxpr).replace(" ", ""), (
+        "ring attention materialized a full TxT score tensor"
+    )

@@ -26,8 +26,8 @@ from trlx_tpu.models.policy import resolve_num_unfrozen
 from trlx_tpu.models.transformer import (
     apply_blocks,
     attention_scores,
-    causal_mask_bias,
     embed_tokens,
+    mask_arg_for,
     init_block_params,
     init_embed_params,
     init_ln_f_params,
@@ -115,7 +115,7 @@ class ILQLModel:
         spec = self.spec
         B, T = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-        mask_bias = causal_mask_bias(attention_mask)
+        mask_bias = mask_arg_for(self._attn(), attention_mask)
         h = embed_tokens(
             params["frozen_base"]["embed"], spec, tokens, positions,
             self.compute_dtype,

@@ -36,12 +36,12 @@ from trlx_tpu.models.heads import head_apply, init_head_params
 from trlx_tpu.models.transformer import (
     apply_blocks,
     attention_scores,
-    causal_mask_bias,
     embed_tokens,
     init_block_params,
     init_embed_params,
     init_ln_f_params,
     layer_norm,
+    mask_arg_for,
     positions_from_mask,
     project_logits,
 )
@@ -116,7 +116,7 @@ class HydraPolicy:
 
     def _trunk(self, params: Params, tokens, attention_mask):
         positions = positions_from_mask(attention_mask)
-        mask_bias = causal_mask_bias(attention_mask)
+        mask_bias = mask_arg_for(self._attn(), attention_mask)
         h = embed_tokens(
             params["frozen_base"]["embed"],
             self.spec,

@@ -318,6 +318,21 @@ def causal_mask_bias(
     return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
 
 
+def mask_arg_for(
+    attention_fn, attention_mask: jnp.ndarray, dtype=jnp.float32
+) -> jnp.ndarray:
+    """The mask argument a given attention_fn expects.
+
+    Ring attention (trlx_tpu.ops.ring_attention) declares
+    ``takes_raw_mask = True`` and receives the raw [B, T] mask — the dense
+    [B, 1, T, T] bias would defeat sequence parallelism's O(T^2) -> O(T^2/sp)
+    memory win. Every other fn gets the additive causal+padding bias.
+    """
+    if getattr(attention_fn, "takes_raw_mask", False):
+        return attention_mask
+    return causal_mask_bias(attention_mask, dtype)
+
+
 def positions_from_mask(attention_mask: jnp.ndarray) -> jnp.ndarray:
     """Position ids that start at 0 on the first *real* token — correct under
     left padding (the reference relies on HF's equivalent handling)."""

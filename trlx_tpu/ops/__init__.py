@@ -1,5 +1,11 @@
 """Numerical ops: losses, GAE, sampling warpers, attention kernels.
 
 Replaces reference trlx/utils/modeling.py and the inline loss math in the
-trainers with jit-native equivalents.
+trainers with jit-native equivalents. Long-context sequence parallelism
+lives in trlx_tpu.ops.ring_attention.
 """
+
+from trlx_tpu.ops.ring_attention import (  # noqa: F401
+    make_sp_attention_fn,
+    ring_attention,
+)

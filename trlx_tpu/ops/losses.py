@@ -52,7 +52,12 @@ def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarra
     logits: [..., T, V]; labels: [..., T] → [..., T]
     """
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # mode="clip": out-of-vocab labels (e.g. a pad id >= model vocab on
+    # masked positions) gather the last logit instead of jnp's default
+    # fill-with-NaN, which would poison masked sums (NaN * 0 = NaN)
+    return jnp.take_along_axis(logp, labels[..., None], axis=-1, mode="clip")[
+        ..., 0
+    ]
 
 
 def gae_advantages(
@@ -173,7 +178,10 @@ def ilql_losses(
     Shapes: logits/qs/target_qs [B, T, V]; vs [B, T]; tokens/attention_mask
     [B, T]; rewards [B, T-1].
     """
-    actions = tokens[:, 1:]
+    # clip actions into vocab: pad ids can exceed the model vocab (e.g. byte
+    # pad 256 on a 21-node graph model); those positions are masked out
+    # below, but an unclipped gather would fill NaN and NaN * 0 = NaN
+    actions = jnp.clip(tokens[:, 1:], 0, logits.shape[-1] - 1)
     nonterminal = attention_mask[:, :-1].astype(jnp.float32)
     n_nonterminal = jnp.maximum(nonterminal.sum(), 1.0)
 

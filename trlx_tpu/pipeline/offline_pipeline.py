@@ -71,8 +71,12 @@ class OfflineRolloutStorage(BaseRolloutStore):
     def create_loader(
         self, batch_size: int, shuffle: bool = False, seed: int = 0,
         eos_token_id: int = 0, drop_last: bool = False,
+        pad_to_multiple: int = 1,
     ) -> Iterator:
+        """`pad_to_multiple` rounds the padded length up so sequence-parallel
+        attention (mesh sp axis) can split it evenly across devices."""
         maxlen = max(len(x) for x in self.input_ids)
+        maxlen = -(-maxlen // pad_to_multiple) * pad_to_multiple
 
         def fetch(idx):
             ids = np.full((len(idx), maxlen), eos_token_id, np.int32)

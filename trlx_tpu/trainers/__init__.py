@@ -94,6 +94,17 @@ class BaseRLTrainer:
             n,
         )
 
+    def _train_attention_fn(self):
+        """Ring attention over the mesh's sp axis when it is >1 (long-context
+        sequence parallelism, trlx_tpu.ops.ring_attention); None selects the
+        dense XLA attention path. Generation keeps the dense KV-cache decode
+        path either way — decode steps attend 1 query token, nothing to ring."""
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            from trlx_tpu.ops.ring_attention import make_sp_attention_fn
+
+            return make_sp_attention_fn(self.mesh)
+        return None
+
     def push_to_store(self, data) -> None:
         """Append experience to the rollout store
         (parity: reference model/__init__.py:46)."""

@@ -59,6 +59,7 @@ class JaxILQLTrainer(BaseRLTrainer):
             two_qs=m.two_qs,
             compute_dtype=DTYPES[config.model.compute_dtype],
             remat=config.train.remat,
+            attention_fn=self._train_attention_fn(),
         )
         if trunk is not None:
             self.params = ilql_params_from_trunk(self.net, *trunk, init_rng)
@@ -292,11 +293,18 @@ class JaxILQLTrainer(BaseRLTrainer):
         clock = Clock()
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
 
+        # the loader's pad id must be a valid model token (masked out in the
+        # loss, but kept in-range so gathers never see out-of-vocab ids) —
+        # byte pad 256 vs a tiny graph vocab would otherwise overflow
+        pad_id = min(eos, self.net.spec.vocab_size - 1)
+        sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
         for epoch in range(cfg.epochs):
             loader = self.train_store.create_loader(
-                cfg.batch_size, shuffle=True, seed=epoch, eos_token_id=eos,
+                cfg.batch_size, shuffle=True, seed=epoch, eos_token_id=pad_id,
                 # a partial final batch can't shard over (dp, fsdp)
                 drop_last=self.mesh is not None,
+                # ring attention needs the padded length divisible by sp
+                pad_to_multiple=sp,
             )
             for batch in loader:
                 if self.iter_count % cfg.eval_interval == 0:

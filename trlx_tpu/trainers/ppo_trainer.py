@@ -86,11 +86,22 @@ class JaxPPOTrainer(BaseRLTrainer):
         rng = jax.random.PRNGKey(config.train.seed)
         self._rng, init_rng, head_rng = jax.random.split(rng, 3)
         spec, trunk = self._load_or_spec(config)
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            T = config.train.input_size + config.train.gen_size
+            sp = self.mesh.shape["sp"]
+            if T % sp != 0:
+                raise ValueError(
+                    f"mesh sp={sp} requires input_size + gen_size "
+                    f"({config.train.input_size} + {config.train.gen_size} "
+                    f"= {T}) to be divisible by it (ring attention splits "
+                    f"the train-time sequence across sp devices)"
+                )
         self.policy = HydraPolicy(
             spec=spec,
             num_layers_unfrozen=config.model.num_layers_unfrozen,
             compute_dtype=compute_dtype,
             remat=config.train.remat,
+            attention_fn=self._train_attention_fn(),
         )
         if trunk is not None:
             self.params = hydra_params_from_trunk(self.policy, *trunk, head_rng)

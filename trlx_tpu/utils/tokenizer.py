@@ -62,6 +62,8 @@ def load_tokenizer(tokenizer_path: str):
     environment hasn't opted out (HF_HUB_OFFLINE)."""
     from trlx_tpu.utils.hf_offline import local_first_attempts
 
+    if tokenizer_path == "byte":  # framework-native name, never a hub repo
+        return ByteTokenizer()
     for kw in local_first_attempts():
         try:
             from transformers import AutoTokenizer
