@@ -92,6 +92,9 @@ class ModelConfig:
     :param model_spec: dict of ModelSpec overrides for from-config models
     :param param_dtype: dtype parameters are stored in
     :param compute_dtype: dtype matmuls/activations run in (bf16 for MXU)
+    :param fused_attention: True forces the Pallas flash-attention kernel
+        for train-time forwards, False forces the dense XLA path, None
+        (default) auto-selects it on TPU for long contexts
     """
 
     model_path: str
@@ -103,6 +106,7 @@ class ModelConfig:
     model_spec: Optional[dict] = None
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    fused_attention: Optional[bool] = None
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -5,6 +5,10 @@ trainers with jit-native equivalents. Long-context sequence parallelism
 lives in trlx_tpu.ops.ring_attention.
 """
 
+from trlx_tpu.ops.pallas_attention import (  # noqa: F401
+    flash_attention,
+    make_pallas_attention_fn,
+)
 from trlx_tpu.ops.ring_attention import (  # noqa: F401
     make_sp_attention_fn,
     ring_attention,

@@ -1,0 +1,362 @@
+"""Fused (flash-style) causal attention as a Pallas TPU kernel.
+
+The hot op of every trunk forward (reference reaches cuDNN attention through
+torch, SURVEY §2.9); here it is a hand-tiled TPU kernel following
+/opt/skills/guides/pallas_guide.md:
+
+- Grid (batch * heads, query blocks); each program streams KV blocks from
+  VMEM through the MXU with an online-softmax accumulator (running max /
+  denominator / f32 accumulator) — the [T, T] score matrix never hits HBM,
+  so memory is O(T * block) instead of O(T^2) and the softmax+matmul chain
+  is fused into one kernel launch.
+- Causality is applied per block; KV blocks entirely above the diagonal are
+  skipped via the fori_loop bound (half the FLOPs of a dense causal mask).
+- Padding comes in as the raw [B, T] attention mask (1 = real), the same
+  contract as trlx_tpu.ops.ring_attention (`takes_raw_mask = True`).
+- Backward is blockwise JAX (lax.scan over KV blocks) wired through
+  jax.custom_vjp: same O(T * block) memory bound, recomputing scores from
+  the saved logsumexp — the standard flash backward, left to XLA to fuse.
+
+The public entry `flash_attention` pads T to a block multiple, reshapes
+[B, T, H, hd] -> [B*H, T, hd] for the grid, and restores the layout after.
+`make_pallas_attention_fn` adapts it to the transformer's attention_fn seam.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9  # matches trlx_tpu.models.transformer.NEG_INF
+
+
+# --------------------------------------------------------------------- #
+# forward kernel
+# --------------------------------------------------------------------- #
+
+
+def _flash_fwd_kernel(
+    q_ref,  # [1, BQ, hd]
+    k_ref,  # [1, T, hd]
+    v_ref,  # [1, T, hd]
+    mask_ref,  # [1, 1, T] (singleton middle axis satisfies TPU tiling)
+    o_ref,  # [1, BQ, hd]
+    lse_ref,  # [1, 1, BQ]
+    *,
+    block_k: int,
+    causal: bool,
+    scale: float,
+):
+    iq = pl.program_id(1)
+    BQ = q_ref.shape[1]
+    T = k_ref.shape[1]
+    hd = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, hd]
+    q_pos = iq * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    num_k_blocks = T // block_k
+    if causal:
+        # skip KV blocks entirely above the diagonal
+        last = (iq + 1) * BQ  # first kv index not attended by this q block
+        num_live = jax.lax.min(num_k_blocks, pl.cdiv(last, block_k))
+    else:
+        num_live = num_k_blocks
+
+    def body(j, carry):
+        m_run, l_run, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kv_mask = mask_ref[0, :, pl.ds(j * block_k, block_k)]  # [1, BK]
+
+        s = jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        bias = jnp.where(kv_mask > 0, 0.0, NEG_INF)
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            bias = bias + jnp.where(q_pos >= kv_pos, 0.0, NEG_INF)
+        s = s + bias
+
+        m_new = jnp.maximum(m_run, s.max(-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_run + p.sum(-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((BQ, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((BQ, 1), jnp.float32)
+    acc0 = jnp.zeros((BQ, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_live, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _pad_t(x, multiple, axis, value=0):
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _flash_forward(q, k, v, kv_mask, block_q, block_k, causal):
+    """Padded + flattened pallas_call. q/k/v: [B, T, H, hd]; mask: [B, T].
+    Returns (out [B, T, H, hd], lse [B, H, Tp])."""
+    B, T, H, hd = q.shape
+    Tp = T + ((-T) % max(block_q, block_k))
+    if Tp % block_q != 0 or Tp % block_k != 0:
+        raise ValueError(
+            f"block_q={block_q} / block_k={block_k} must divide the padded "
+            f"length {Tp} (T={T} rounded up to max(block_q, block_k)); "
+            f"a grid short of blocks would silently leave trailing query "
+            f"rows unwritten"
+        )
+    qf = _pad_t(q, max(block_q, block_k), 1)
+    kf = _pad_t(k, max(block_q, block_k), 1)
+    vf = _pad_t(v, max(block_q, block_k), 1)
+    maskf = _pad_t(kv_mask, max(block_q, block_k), 1)
+
+    # [B, T, H, hd] -> [B*H, T, hd]
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, Tp, hd)
+
+    qf, kf, vf = flat(qf), flat(kf), flat(vf)
+
+    grid = (B * H, Tp // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_k=block_k,
+        causal=causal,
+        scale=1.0 / (hd**0.5),
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, hd), lambda bh, iq: (bh, iq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Tp, hd), lambda bh, iq: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, Tp, hd), lambda bh, iq: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, Tp), lambda bh, iq, H=H: (bh // H, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, hd), lambda bh, iq: (bh, iq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q), lambda bh, iq: (bh, 0, iq),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, hd), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, Tp), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(qf, kf, vf, maskf[:, None, :])
+
+    out = out.reshape(B, H, Tp, hd).transpose(0, 2, 1, 3)[:, :T]
+    return out, lse.reshape(B, H, Tp)  # lse kept at padded length
+
+
+# --------------------------------------------------------------------- #
+# blockwise backward (JAX; same O(T * block) memory bound)
+# --------------------------------------------------------------------- #
+
+
+def _flash_backward(res, g, block_k, causal):
+    q, k, v, kv_mask, out, lse = res
+    B, T, H, hd = q.shape
+    scale = 1.0 / (hd**0.5)
+    Tp = lse.shape[-1]  # padded length the forward ran at
+
+    def pad(x):
+        return _pad_t(x, Tp, 1)
+
+    q32 = pad(q).astype(jnp.float32) * scale
+    k32 = pad(k).astype(jnp.float32)
+    v32 = pad(v).astype(jnp.float32)
+    g32 = pad(g).astype(jnp.float32)
+    maskf = pad(kv_mask)
+    lse_q = lse[..., None]  # [B, H, Tp, 1]
+    # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term
+    D = (g32 * pad(out).astype(jnp.float32)).sum(-1).transpose(0, 2, 1)[
+        ..., None
+    ]  # [B, H, Tp, 1]
+
+    n_blocks = Tp // block_k
+    blk_pos = jnp.arange(block_k)
+
+    # iterate only the live (query block, kv block) tile pairs — causal
+    # skips the above-diagonal half, matching the forward's num_live bound
+    if causal:
+        pairs = [(i, j) for i in range(n_blocks) for j in range(i + 1)]
+    else:
+        pairs = [(i, j) for i in range(n_blocks) for j in range(n_blocks)]
+    pair_idx = jnp.asarray(pairs, jnp.int32)  # [P, 2]
+
+    def slice_q(x, i):
+        return jax.lax.dynamic_slice_in_dim(x, i * block_k, block_k, 1)
+
+    def body(carry, pair):
+        dq, dk, dv = carry
+        i, j = pair[0], pair[1]
+        q_blk = slice_q(q32, i)
+        g_blk = slice_q(g32, i)
+        lse_blk = jax.lax.dynamic_slice_in_dim(lse_q, i * block_k, block_k, 2)
+        D_blk = jax.lax.dynamic_slice_in_dim(D, i * block_k, block_k, 2)
+        k_blk = slice_q(k32, j)
+        v_blk = slice_q(v32, j)
+        m_blk = slice_q(maskf, j)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk)
+        bias = jnp.where(m_blk[:, None, None, :] > 0, 0.0, NEG_INF)
+        if causal:
+            q_pos = i * block_k + blk_pos
+            kv_pos = j * block_k + blk_pos
+            bias = bias + jnp.where(
+                q_pos[:, None] >= kv_pos[None, :], 0.0, NEG_INF
+            )[None, None]
+        p = jnp.exp(s + bias - lse_blk)  # [B, H, BQ, BK]
+
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, v_blk)
+        ds = p * (dp - D_blk)
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk) * scale
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk)
+
+        def acc(buf, blk, at):
+            old = jax.lax.dynamic_slice_in_dim(buf, at * block_k, block_k, 1)
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, old + blk, at * block_k, 1
+            )
+
+        return (acc(dq, dq_blk, i), acc(dk, dk_blk, j), acc(dv, dv_blk, j)), None
+
+    zeros = jnp.zeros((B, Tp, H, hd), jnp.float32)
+    (dq, dk, dv), _ = jax.lax.scan(
+        body, (zeros, zeros, zeros), pair_idx
+    )
+
+    return (
+        dq[:, :T].astype(q.dtype),
+        dk[:, :T].astype(k.dtype),
+        dv[:, :T].astype(v.dtype),
+        None,
+    )
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: jnp.ndarray,
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Fused causal attention. q/k/v: [B, T, H, hd]; kv_mask: [B, T]
+    (1 = real token). Returns [B, T, H, hd] in q's dtype."""
+    out, _ = _flash_forward(q, k, v, kv_mask, block_q, block_k, causal)
+    return out
+
+
+def _fwd(q, k, v, kv_mask, block_q, block_k, causal):
+    out, lse = _flash_forward(q, k, v, kv_mask, block_q, block_k, causal)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _bwd(block_q, block_k, causal, res, g):
+    return _flash_backward(res, g, block_k, causal)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+# Below this many tokens the kernel can't win (and Mosaic rejects
+# sub-128-lane mask blocks on real hardware — confirmed on v5e); the dense
+# XLA path handles short batches.
+_MIN_FUSED_T = 128
+
+
+def make_pallas_attention_fn(
+    block: int = 128, causal: bool = True, mesh=None
+):
+    """An `attention_fn` for the transformer trunk running the fused Pallas
+    kernel. Takes the raw [B, T] mask (`takes_raw_mask = True`) like the
+    ring-attention fn — no dense T x T bias is ever built.
+
+    Per-call adaptivity (the actual batch length can differ from the config
+    — ILQL pads to each batch's own max): sequences shorter than
+    `_MIN_FUSED_T` fall back to dense XLA attention. With a `mesh`, the
+    kernel runs under shard_map (batch over (dp, fsdp), heads over tp) —
+    a bare Mosaic custom call has no GSPMD partitioning rule, so without
+    the wrapper a multichip jit would gather the global batch per chip."""
+    from trlx_tpu.models.transformer import attention_scores, causal_mask_bias
+
+    try:  # jax >= 0.8
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def pallas_attention(q, k, v, attention_mask):
+        if q.shape[1] < _MIN_FUSED_T:
+            return attention_scores(
+                q, k, v, causal_mask_bias(attention_mask)
+            )
+        if mesh is None:
+            return flash_attention(q, k, v, attention_mask, block, block,
+                                   causal)
+        n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+        batch_ax = ("dp", "fsdp") if q.shape[0] % n_data == 0 else None
+        head_ax = "tp" if q.shape[2] % mesh.shape["tp"] == 0 else None
+        qkv_spec = P(batch_ax, None, head_ax, None)
+        mask_spec = P(batch_ax, None)
+        return shard_map(
+            lambda q, k, v, m: flash_attention(q, k, v, m, block, block,
+                                               causal),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+            # pallas_call's out_shape carries no varying-mesh-axes type;
+            # skip the vma check for this purely per-shard kernel
+            check_vma=False,
+        )(q, k, v, attention_mask)
+
+    pallas_attention.takes_raw_mask = True
+    return pallas_attention

@@ -94,15 +94,39 @@ class BaseRLTrainer:
             n,
         )
 
+    # auto-enable threshold, set from v5e measurements of the full train
+    # step (fwd+bwd): dense wins slightly through 4k (21.8 vs 24.7 ms at
+    # 4096) because the flash backward is blockwise JAX, but collapses at
+    # 8k (707 vs 93 ms — 7.6x) where the T x T score tensors blow past
+    # cache/HBM headroom; the kernel's O(T * block) memory also frees HBM
+    # for batch at any length (force via model.fused_attention: true)
+    FUSED_ATTENTION_MIN_T = 4096
+
     def _train_attention_fn(self):
-        """Ring attention over the mesh's sp axis when it is >1 (long-context
-        sequence parallelism, trlx_tpu.ops.ring_attention); None selects the
-        dense XLA attention path. Generation keeps the dense KV-cache decode
-        path either way — decode steps attend 1 query token, nothing to ring."""
+        """Attention implementation for train-time forwards, in precedence
+        order: ring attention when the mesh has an sp axis > 1 (sequence
+        parallelism, trlx_tpu.ops.ring_attention); the fused Pallas kernel
+        on TPU for long contexts or when model.fused_attention forces it
+        (trlx_tpu.ops.pallas_attention); else None = dense XLA attention.
+        Generation keeps the dense KV-cache decode path either way — decode
+        steps attend 1 query token, nothing to fuse."""
+        import jax
+
         if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
             from trlx_tpu.ops.ring_attention import make_sp_attention_fn
 
             return make_sp_attention_fn(self.mesh)
+        fused = self.config.model.fused_attention
+        if fused is None:
+            T = self.config.train.input_size + self.config.train.gen_size
+            fused = (
+                jax.default_backend() == "tpu"
+                and T >= self.FUSED_ATTENTION_MIN_T
+            )
+        if fused:
+            from trlx_tpu.ops.pallas_attention import make_pallas_attention_fn
+
+            return make_pallas_attention_fn(mesh=self.mesh)
         return None
 
     def push_to_store(self, data) -> None:
