@@ -101,9 +101,14 @@ def test_flash_non_causal():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_ppo_e2e_with_fused_attention():
+def test_ppo_e2e_with_fused_attention(monkeypatch):
     """model.fused_attention: true forces the Pallas kernel through the
-    trainer seam; the rollout -> train loop must run and stay finite."""
+    trainer seam; the rollout -> train loop must run and stay finite.
+    _MIN_FUSED_T is dropped so the tiny T=12 forwards really exercise the
+    kernel (and its custom-vjp gradients) instead of the dense fallback."""
+    import trlx_tpu.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_MIN_FUSED_T", 0)
     from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
     from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
     from trlx_tpu.utils.tokenizer import ByteTokenizer

@@ -395,7 +395,9 @@ def apply_blocks_with_cache(
 
     h: [B, T, D] fresh suffix; cache: ([L, B, S, H, hd], ...) full buffers;
     mask_bias: [B, 1, T, S] against the buffer; cache_offset: scalar buffer
-    index where the fresh suffix starts.
+    index where the fresh suffix starts. (Unrolling the layer scan was
+    measured on v5e and does not improve decode latency — XLA pipelines
+    the scan body already.)
     """
     flags = ArchFlags.for_spec(spec)
 

@@ -336,9 +336,13 @@ def make_pallas_attention_fn(
 
     def pallas_attention(q, k, v, attention_mask):
         if q.shape[1] < _MIN_FUSED_T:
-            return attention_scores(
-                q, k, v, causal_mask_bias(attention_mask)
-            )
+            bias = causal_mask_bias(attention_mask)
+            if not causal:
+                # padding-only bias: every (real) key visible to every query
+                bias = jnp.where(
+                    attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+                ).astype(jnp.float32)
+            return attention_scores(q, k, v, bias)
         if mesh is None:
             return flash_attention(q, k, v, attention_mask, block, block,
                                    causal)

@@ -16,6 +16,7 @@ TPU-first differences:
 
 from typing import Callable
 
+import jax
 import numpy as np
 
 from trlx_tpu.data.ppo_types import PPORLBatch
@@ -89,8 +90,21 @@ class PPOOrchestrator(Orchestrator):
                 q2, m2 = self._next_prompts()
                 pending = (q2, m2, trainer.generate(q2, m2))
 
-            sequences = np.asarray(gen.sequences)
-            attn_mask = np.asarray(gen.attention_mask)
+            # dispatch device scoring on the device-resident generation
+            # outputs — it does not need the (host) task scores, which are
+            # added to the last real token below
+            scored = trainer.score_experience(
+                gen.sequences, gen.attention_mask, gen.gen_mask
+            )
+
+            # ONE batched device->host fetch per chunk: per-array pulls
+            # each pay a full host<->device round trip (dominant on
+            # tunneled/remote device topologies)
+            (sequences, gen_mask, gen_tokens, logprobs, values, kl_rewards,
+             seq_kl) = jax.device_get(
+                (gen.sequences, gen.gen_mask, gen.gen_tokens) + tuple(scored)
+            )
+            gen_mask = gen_mask.astype(np.int32)
 
             texts = trainer.tokenizer.batch_decode(
                 sequences, skip_special_tokens=True
@@ -98,15 +112,18 @@ class PPOOrchestrator(Orchestrator):
             scores = self.score(texts)
             all_scores.append(scores)
 
-            gen_mask = np.asarray(gen.gen_mask, np.int32)
-            logprobs, values, rewards, mean_kl = trainer.score_experience(
-                sequences, attn_mask, gen_mask, scores
-            )
+            # score lands on each row's last REAL response token (parity:
+            # reference ppo_orchestrator.py:92 via kl_penalty_rewards'
+            # masked-last-token rule)
+            rewards = np.array(kl_rewards)
+            last = np.maximum(gen_mask.sum(axis=-1) - 1, 0)
+            rewards[np.arange(rewards.shape[0]), last] += scores
+            mean_kl = float(seq_kl.mean())
             all_kls.append(mean_kl)
 
             batch = PPORLBatch(
                 query_tensors=np.asarray(query, np.int32),
-                response_tensors=np.asarray(gen.gen_tokens, np.int32),
+                response_tensors=gen_tokens.astype(np.int32),
                 logprobs=logprobs,
                 values=values,
                 rewards=rewards,

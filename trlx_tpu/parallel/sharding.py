@@ -197,7 +197,6 @@ def shard_batch(mesh: Mesh, tree):
                 f"{n_data} (mesh {dict(mesh.shape)}); pick batch_size / "
                 f"chunk_size / eval n as a multiple of {n_data}"
             )
-    sharding = batch_sharding(mesh)
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), tree
-    )
+    # one device_put for the whole tree (a single sharding broadcasts over
+    # all leaves) — per-leaf puts each pay a host<->device round trip
+    return jax.device_put(tree, batch_sharding(mesh))

@@ -58,14 +58,17 @@ class BaseRLTrainer:
 
     def _put(self, tree):
         """Host batch -> device: sharded over (dp, fsdp) when a mesh is
-        active, plain transfer otherwise."""
+        active, plain transfer otherwise.
+
+        Always ONE `jax.device_put` for the whole tree: per-leaf transfers
+        each pay a host<->device round trip, which dominates wall-clock on
+        tunneled/remote device topologies."""
         import jax
-        import jax.numpy as jnp
 
         from trlx_tpu.parallel import shard_batch
 
         if self.mesh is None:
-            return jax.tree_util.tree_map(jnp.asarray, tree)
+            return jax.device_put(tree)
         return shard_batch(self.mesh, tree)
 
     def _pad_rows(self, tree):

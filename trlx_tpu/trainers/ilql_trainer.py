@@ -207,10 +207,13 @@ class JaxILQLTrainer(BaseRLTrainer):
     def act(self, batch):
         query, mask = batch
         out = self.generate(query, mask)
-        texts = self.tokenizer.batch_decode(
-            np.asarray(out.sequences), skip_special_tokens=True
+        # one batched device->host fetch (round trips dominate on tunneled
+        # device topologies)
+        sequences, gen_tokens = jax.device_get(
+            (out.sequences, out.gen_tokens)
         )
-        return np.asarray(query), np.asarray(out.gen_tokens), texts
+        texts = self.tokenizer.batch_decode(sequences, skip_special_tokens=True)
+        return np.asarray(query), gen_tokens, texts
 
     def sample(self, prompts, length: int = None, n_samples: int = None):
         query, mask = self._encode_prompts(prompts)
@@ -323,7 +326,10 @@ class JaxILQLTrainer(BaseRLTrainer):
                     self.params = self._sync(self.params)
 
                 if self.iter_count % cfg.log_interval == 0:
-                    host = {k: float(v) for k, v in stats.items()}
+                    host = {
+                        k: float(v)
+                        for k, v in jax.device_get(stats).items()
+                    }
                     host.update(
                         iter=self.iter_count,
                         epoch=epoch,

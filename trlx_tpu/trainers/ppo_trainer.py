@@ -166,10 +166,12 @@ class JaxPPOTrainer(BaseRLTrainer):
             )
 
         def score_fn(params, sequences, attention_mask, response_mask,
-                     scores, kl_coef, input_size):
+                     kl_coef, input_size):
             """One shared-trunk forward → (logprobs, ref_logprobs, values)
-            over the response window + KL-penalty rewards, with pads emitted
-            after eos excluded (score lands on the last REAL token).
+            over the response window + KL-penalty rewards WITHOUT the task
+            score (the host adds it to the last real token after reward_fn
+            runs — keeps this dispatchable before the reward exists, so one
+            host round trip covers generation + scoring).
 
             Replaces the reference's two forward passes + host KL math
             (ppo_orchestrator.py:70-98)."""
@@ -183,7 +185,9 @@ class JaxPPOTrainer(BaseRLTrainer):
             ref_logprobs = logprobs_from_logits(ref_logits[:, window], response)
             vals = values[:, window]
             rewards, seq_kl = kl_penalty_rewards(
-                logprobs, ref_logprobs, scores, kl_coef, mask=response_mask
+                logprobs, ref_logprobs,
+                jnp.zeros(sequences.shape[0], jnp.float32),
+                kl_coef, mask=response_mask,
             )
             return logprobs, vals, rewards, seq_kl
 
@@ -260,10 +264,11 @@ class JaxPPOTrainer(BaseRLTrainer):
         texts) (parity: reference accelerate_base_model.py:103-130)."""
         query, mask = batch
         out = self.generate(query, mask)
-        texts = self.tokenizer.batch_decode(
-            np.asarray(out.sequences), skip_special_tokens=True
+        sequences, gen_tokens = jax.device_get(
+            (out.sequences, out.gen_tokens)
         )
-        return np.asarray(query), np.asarray(out.gen_tokens), texts
+        texts = self.tokenizer.batch_decode(sequences, skip_special_tokens=True)
+        return np.asarray(query), gen_tokens, texts
 
     def sample(self, prompts, length: int, n_samples: int):
         enc = self.tokenizer(
@@ -277,26 +282,29 @@ class JaxPPOTrainer(BaseRLTrainer):
         )
         return self.tokenizer.batch_decode(np.asarray(out.sequences))
 
-    def score_experience(self, sequences, attention_mask, response_mask,
-                         scores):
-        """Device scoring for the orchestrator; returns numpy
-        (logprobs, values, rewards, mean_kl)."""
-        seqs, attn, rmask, sc = self._put((
-            np.asarray(sequences),
-            np.asarray(attention_mask),
-            np.asarray(response_mask),
-            np.asarray(scores, np.float32),
-        ))
-        logprobs, vals, rewards, seq_kl = self._score_fn(
-            self.params, seqs, attn, rmask, sc,
+    def score_experience(self, sequences, attention_mask, response_mask):
+        """Dispatch device scoring; returns DEVICE arrays
+        (logprobs, values, kl_rewards, seq_kl) — no host sync.
+
+        kl_rewards carry only the per-token KL penalty; the caller adds the
+        task score to each row's last real token after reward_fn runs (the
+        orchestrator batches that into its single per-chunk device_get).
+        Inputs already on device (the generation outputs) are used in
+        place; host arrays are uploaded in one transfer."""
+        host, dev = {}, {}
+        for name, x in (("seqs", sequences), ("attn", attention_mask),
+                        ("rmask", response_mask)):
+            if isinstance(x, jax.Array):
+                dev[name] = x
+            else:
+                host[name] = np.asarray(x)
+        if host:
+            host = dict(zip(host.keys(), self._put(tuple(host.values()))))
+        put = {**dev, **host}
+        return self._score_fn(
+            self.params, put["seqs"], put["attn"], put["rmask"],
             jnp.float32(self.kl_ctl.value),
             self.config.train.input_size,
-        )
-        return (
-            np.asarray(logprobs),
-            np.asarray(vals),
-            np.asarray(rewards),
-            float(seq_kl.mean()),
         )
 
     def get_components(self) -> Dict:
@@ -339,15 +347,16 @@ class JaxPPOTrainer(BaseRLTrainer):
                 return {}
         query, mask = eval_prompts
         out = self.generate(query, mask)
-        texts = self.tokenizer.batch_decode(
-            np.asarray(out.sequences), skip_special_tokens=True
+        sequences, gen_tokens = jax.device_get(
+            (out.sequences, out.gen_tokens)
         )
+        texts = self.tokenizer.batch_decode(sequences, skip_special_tokens=True)
         scores = np.asarray(self.reward_fn(texts), np.float32)
         query_texts = self.tokenizer.batch_decode(
             np.asarray(query), skip_special_tokens=True
         )
         response_texts = self.tokenizer.batch_decode(
-            np.asarray(out.gen_tokens), skip_special_tokens=True
+            gen_tokens, skip_special_tokens=True
         )
         return {
             "mean_score": float(scores.mean()),
@@ -386,7 +395,8 @@ class JaxPPOTrainer(BaseRLTrainer):
                 intervals = self.intervals(self.iter_count)
                 if intervals["do_log"]:
                     host_stats = {
-                        k: float(v) for k, v in stats.items()
+                        k: float(v)
+                        for k, v in jax.device_get(stats).items()
                     }
                     host_stats.update(
                         iter=self.iter_count,
