@@ -45,3 +45,25 @@ def test_clock():
     c.tick(100)
     assert c.total_samples == 100
     assert c.samples_per_second() > 0
+
+
+def test_profiling_noop_without_env(monkeypatch):
+    from trlx_tpu.utils.profiling import annotate, maybe_trace
+
+    monkeypatch.delenv("TRLX_TPU_PROFILE_DIR", raising=False)
+    with maybe_trace():
+        with annotate("phase"):
+            pass  # no-op path: no jax.profiler import, no trace started
+
+
+def test_profiling_writes_trace(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.profiling import annotate, maybe_trace
+
+    monkeypatch.setenv("TRLX_TPU_PROFILE_DIR", str(tmp_path))
+    with maybe_trace():
+        with annotate("phase"):
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    produced = list(tmp_path.rglob("*"))
+    assert produced, "no trace files written"

@@ -336,9 +336,9 @@ def make_pallas_attention_fn(
 
     def pallas_attention(q, k, v, attention_mask):
         if q.shape[1] < _MIN_FUSED_T:
-            bias = causal_mask_bias(attention_mask)
-            if not causal:
-                # padding-only bias: every (real) key visible to every query
+            if causal:
+                bias = causal_mask_bias(attention_mask)
+            else:  # padding-only: every (real) key visible to every query
                 bias = jnp.where(
                     attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
                 ).astype(jnp.float32)

@@ -86,16 +86,18 @@ class PPOOrchestrator(Orchestrator):
         all_scores = []
         for i in range(n_chunks):
             query, qmask, gen = pending
-            if i + 1 < n_chunks:
-                q2, m2 = self._next_prompts()
-                pending = (q2, m2, trainer.generate(q2, m2))
 
             # dispatch device scoring on the device-resident generation
             # outputs — it does not need the (host) task scores, which are
-            # added to the last real token below
+            # added to the last real token below. Dispatched BEFORE the
+            # next chunk's generate so the in-order device stream completes
+            # score(i) first and host reward_fn overlaps generate(i+1).
             scored = trainer.score_experience(
                 gen.sequences, gen.attention_mask, gen.gen_mask
             )
+            if i + 1 < n_chunks:
+                q2, m2 = self._next_prompts()
+                pending = (q2, m2, trainer.generate(q2, m2))
 
             # ONE batched device->host fetch per chunk: per-array pulls
             # each pay a full host<->device round trip (dominant on

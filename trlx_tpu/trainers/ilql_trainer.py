@@ -290,6 +290,14 @@ class JaxILQLTrainer(BaseRLTrainer):
         return logs
 
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
+        """Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace
+        of the loop (trlx_tpu.utils.profiling)."""
+        from trlx_tpu.utils.profiling import maybe_trace
+
+        with maybe_trace():
+            self._learn_loop(log_fn, save_fn, eval_fn)
+
+    def _learn_loop(self, log_fn=None, save_fn=None, eval_fn=None):
         cfg = self.config.train
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))

@@ -372,12 +372,21 @@ class JaxPPOTrainer(BaseRLTrainer):
         """PPO optimization loop (parity: reference
         accelerate_ppo_model.py:163-209): iterate minibatches over the
         rollout store, `ppo_epochs` passes per batch, KL-coef update +
-        periodic eval between batches, fresh experience each outer epoch."""
+        periodic eval between batches, fresh experience each outer epoch.
+
+        Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace of
+        the loop (trlx_tpu.utils.profiling)."""
+        from trlx_tpu.utils.profiling import annotate, maybe_trace
+
         cfg = self.config.train
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         clock = Clock()
 
+        with maybe_trace():
+            self._learn_loop(log_fn, cfg, m, clock, annotate)
+
+    def _learn_loop(self, log_fn, cfg, m, clock, annotate):
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
             loader = self.store.create_loader(
                 cfg.batch_size, shuffle=True, seed=self.epoch
@@ -385,11 +394,12 @@ class JaxPPOTrainer(BaseRLTrainer):
             for batch in loader:
                 batch = self._put(batch)
                 stats = None
-                for _ in range(m.ppo_epochs):
-                    self.params, self.opt_state, stats = self._train_step(
-                        self.params, self.opt_state, batch
-                    )
-                    self.iter_count += 1
+                with annotate("ppo_update"):
+                    for _ in range(m.ppo_epochs):
+                        self.params, self.opt_state, stats = self._train_step(
+                            self.params, self.opt_state, batch
+                        )
+                        self.iter_count += 1
                 clock.tick(len(batch.query_tensors) * m.ppo_epochs)
 
                 intervals = self.intervals(self.iter_count)
@@ -420,7 +430,10 @@ class JaxPPOTrainer(BaseRLTrainer):
             if self.orch is not None and self.iter_count < cfg.total_steps \
                     and self.epoch < cfg.epochs:
                 self.store.clear_history()
-                info = self.orch.make_experience(m.num_rollouts, self.iter_count)
+                with annotate("rollout_refresh"):
+                    info = self.orch.make_experience(
+                        m.num_rollouts, self.iter_count
+                    )
                 log_fn({"iter": self.iter_count, "epoch": self.epoch, **info})
 
     def post_rollout_kl_update(self, mean_kl: float, n_samples: int) -> None:

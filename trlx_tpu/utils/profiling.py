@@ -1,0 +1,58 @@
+"""Profiling hooks: jax.profiler traces + named step annotations.
+
+The reference's only tracing is the hand-rolled `Clock` (reference:
+trlx/utils/__init__.py:50-88, SURVEY §5 "tracing: minimal"); here the same
+wall-clock metrics are kept (trlx_tpu.utils.Clock) and real device traces
+are added on top:
+
+- set ``TRLX_TPU_PROFILE_DIR=/path`` (or pass `trace_dir`) and the learn
+  loops wrap themselves in `jax.profiler.trace`, producing a TensorBoard-
+  loadable trace of the jitted generate/score/train programs;
+- `annotate(name)` marks host-side phases (rollout, reward_fn, update) so
+  they are attributable inside the trace timeline.
+
+Zero overhead when disabled: both helpers collapse to no-op context
+managers unless a trace directory is configured.
+"""
+
+import contextlib
+import os
+from typing import Optional
+
+_ENV_VAR = "TRLX_TPU_PROFILE_DIR"
+
+_tracing_active = False  # set while a maybe_trace() region is open
+
+
+def trace_dir_from_env() -> Optional[str]:
+    return os.environ.get(_ENV_VAR) or None
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str] = None):
+    """jax.profiler.trace(trace_dir) when a directory is configured
+    (argument or $TRLX_TPU_PROFILE_DIR); no-op otherwise."""
+    global _tracing_active
+    trace_dir = trace_dir or trace_dir_from_env()
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    _tracing_active = True
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+    finally:
+        _tracing_active = False
+
+
+def annotate(name: str):
+    """Named host-span annotation visible in profiler traces; no-op unless
+    a maybe_trace() region is active (TraceAnnotation is cheap but not
+    free)."""
+    if not _tracing_active:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
