@@ -1,0 +1,20 @@
+# Sphinx configuration (parity: reference docs/source/conf.py).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "trlx_tpu"
+copyright = "2026"
+author = "trlx_tpu contributors"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = "alabaster"
