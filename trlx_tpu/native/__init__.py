@@ -1,0 +1,190 @@
+"""Native (C++) host-runtime components, bound via ctypes.
+
+The TPU compute path is XLA/Pallas; this package natively implements the
+HOST side of the data path — the role torch's C++ DataLoader workers and
+HF's Rust tokenizers play in the reference's stack (SURVEY §2.9):
+
+- ``byte_tokenize_pad``: threaded UTF-8 byte tokenization with left/right
+  padding (the ByteTokenizer hot path for large prompt sets);
+- ``pad_collate``: threaded right-pad collation of variable-length token /
+  mask / reward rows (the offline-store loader hot loop).
+
+``hostdata.cpp`` is compiled on demand with the system C++ compiler into a
+per-version cached shared object (no pybind11 — plain ``extern "C"`` +
+ctypes, per the environment's binding constraints). Everything degrades to
+the pure-Python implementations when no compiler is available:
+``available()`` gates every call site.
+"""
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("hostdata.cpp")
+_lib = None
+_tried = False
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("TRLX_TPU_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "trlx_tpu_native"
+    )
+    p = Path(base)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None or not _SRC.exists():
+        return None
+    tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    so = _cache_dir() / f"hostdata_{tag}.so"
+
+    def compile_to(path: Path) -> bool:
+        # unique tmp per process: concurrent first-use builds (pytest
+        # workers, multi-host) must not interleave writes into one file
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        cmd = [
+            cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            str(_SRC), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, path)  # atomic publish
+            return True
+        except (subprocess.SubprocessError, OSError):
+            tmp.unlink(missing_ok=True)
+            return False
+
+    if not so.exists() and not compile_to(so):
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        # a corrupt cached artifact must not permanently disable the
+        # native path — rebuild once
+        so.unlink(missing_ok=True)
+        if not compile_to(so):
+            return None
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError:
+            return None
+
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    charpp = ctypes.POINTER(ctypes.c_char_p)
+    lib.td_byte_tokenize_pad.argtypes = [
+        charpp, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int, ctypes.c_int, i32p, i32p,
+    ]
+    lib.td_byte_tokenize_pad.restype = None
+    lib.td_pad_collate.argtypes = [
+        ctypes.POINTER(i32p), ctypes.POINTER(i32p), ctypes.POINTER(f32p),
+        i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int,
+        i32p, i32p, f32p,
+    ]
+    lib.td_pad_collate.restype = None
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        if os.environ.get("TRLX_TPU_NO_NATIVE"):
+            _lib = None
+        else:
+            _lib = _build()
+    return _lib
+
+
+def available() -> bool:
+    """True when the native library compiled/loaded on this machine."""
+    return _get() is not None
+
+
+def byte_tokenize_pad(texts, max_len: int, pad_id: int,
+                      pad_left: bool = True, threads: int = 0):
+    """UTF-8 byte tokenization of `texts` padded/truncated to `max_len`.
+    Returns (ids [n, max_len] int32, mask [n, max_len] int32)."""
+    lib = _get()
+    assert lib is not None, "native hostdata unavailable (check available())"
+    raw = [t.encode("utf-8") for t in texts]
+    n = len(raw)
+    arr = (ctypes.c_char_p * n)(*raw)
+    lens = np.asarray([len(r) for r in raw], np.int64)
+    ids = np.empty((n, max_len), np.int32)
+    mask = np.empty((n, max_len), np.int32)
+    lib.td_byte_tokenize_pad(
+        arr, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, max_len, pad_id, int(pad_left), threads,
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return ids, mask
+
+
+def pad_collate(rows, masks, rewards, max_len: int, pad_id: int,
+                threads: int = 0):
+    """Right-pad collation of variable-length rows.
+
+    rows: list of int32 arrays; masks: list of int32 arrays or None;
+    rewards: list of float32 arrays (len-1 each) or None. Returns
+    (ids [n, max_len], mask [n, max_len], rewards [n, max_len-1] | None).
+    """
+    lib = _get()
+    assert lib is not None, "native hostdata unavailable (check available())"
+    n = len(rows)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    rows = [np.ascontiguousarray(r, np.int32) for r in rows]
+    row_ptrs = (i32p * n)(*[r.ctypes.data_as(i32p) for r in rows])
+    lens = np.asarray([len(r) for r in rows], np.int64)
+
+    if masks is not None:
+        masks = [np.ascontiguousarray(m, np.int32) for m in masks]
+        for i, (r, m) in enumerate(zip(rows, masks)):
+            if len(m) != len(r):  # the C side memcpy's len(row) elements —
+                raise ValueError(  # a short row would be an OOB heap read
+                    f"mask row {i} has length {len(m)}, expected {len(r)}"
+                )
+        mask_ptrs = (i32p * n)(*[m.ctypes.data_as(i32p) for m in masks])
+    else:
+        mask_ptrs = ctypes.cast(None, ctypes.POINTER(i32p))
+
+    out_rewards = None
+    if rewards is not None:
+        rewards = [np.ascontiguousarray(r, np.float32) for r in rewards]
+        for i, (r, rw) in enumerate(zip(rows, rewards)):
+            if len(r) > 1 and len(rw) != len(r) - 1:
+                raise ValueError(
+                    f"rewards row {i} has length {len(rw)}, expected "
+                    f"{len(r) - 1} (one per transition)"
+                )
+        reward_ptrs = (f32p * n)(*[r.ctypes.data_as(f32p) for r in rewards])
+        out_rewards = np.empty((n, max_len - 1), np.float32)
+        out_rw_ptr = out_rewards.ctypes.data_as(f32p)
+    else:
+        reward_ptrs = ctypes.cast(None, ctypes.POINTER(f32p))
+        out_rw_ptr = ctypes.cast(None, f32p)
+
+    ids = np.empty((n, max_len), np.int32)
+    mask = np.empty((n, max_len), np.int32)
+    lib.td_pad_collate(
+        row_ptrs, mask_ptrs, reward_ptrs,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, max_len, pad_id, threads,
+        ids.ctypes.data_as(i32p), mask.ctypes.data_as(i32p), out_rw_ptr,
+    )
+    return ids, mask, out_rewards

@@ -79,6 +79,17 @@ class OfflineRolloutStorage(BaseRolloutStore):
         maxlen = -(-maxlen // pad_to_multiple) * pad_to_multiple
 
         def fetch(idx):
+            from trlx_tpu import native
+
+            if native.available():
+                # threaded C++ collation (trlx_tpu/native/hostdata.cpp)
+                ids, mask, rewards = native.pad_collate(
+                    [self.input_ids[i] for i in idx],
+                    [self.attention_mask[i] for i in idx],
+                    [self.rewards[i] for i in idx],
+                    maxlen, eos_token_id,
+                )
+                return ILQLBatch(ids, mask, rewards)
             ids = np.full((len(idx), maxlen), eos_token_id, np.int32)
             mask = np.zeros((len(idx), maxlen), np.int32)
             rewards = np.zeros((len(idx), maxlen - 1), np.float32)

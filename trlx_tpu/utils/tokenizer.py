@@ -41,6 +41,19 @@ class ByteTokenizer:
 
         if isinstance(texts, str):
             texts = [texts]
+
+        from trlx_tpu import native
+
+        if native.available() and len(texts) >= 64:
+            # threaded C++ tokenize+pad (trlx_tpu/native/hostdata.cpp) for
+            # large prompt sets; identical layout to the loop below
+            if max_length is None:
+                max_length = max(len(t.encode("utf-8")) for t in texts)
+            ids, mask = native.byte_tokenize_pad(
+                texts, max_length, self.pad_token_id, pad_left=True
+            )
+            return {"input_ids": ids, "attention_mask": mask}
+
         enc = [self.encode(t) for t in texts]
         if max_length is None:
             max_length = max(len(e) for e in enc)
