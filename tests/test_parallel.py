@@ -195,3 +195,90 @@ def test_sharded_ppo_e2e_smoke(devices):
     logs = []
     meshed.learn(log_fn=logs.append)
     assert meshed.iter_count > 0
+
+
+@pytest.mark.parametrize("arch", ["gptj", "gptneox"])
+def test_tp_sharded_forward_matches_dense_other_arches(devices, arch):
+    """VERDICT item 6: tensor-parallel forward parity for the gpt-j /
+    gpt-neox families (rotary, parallel blocks, untied heads — the
+    structures the ppo_gptj.yml workload shards over tp)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.configs import ModelSpec
+    from trlx_tpu.models.policy import HydraPolicy
+    from trlx_tpu.parallel import shard_params
+
+    spec = ModelSpec(
+        arch=arch, vocab_size=64, n_layer=2, n_head=4, d_model=32,
+        n_positions=32, rotary_dim=8 if arch == "gptj" else 0,
+        tie_lm_head=False,
+    )
+    policy = HydraPolicy(
+        spec=spec, num_layers_unfrozen=1, compute_dtype=jnp.float32
+    )
+    params = policy.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    mask = jnp.ones((4, 16), jnp.int32)
+    logits, ref, values = policy.forward(params, tokens, mask)
+
+    mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    sharded = shard_params(mesh, params)
+    # tp must actually partition the attention projections
+    wq = sharded["trainable"]["blocks"]["attn"]["wq"]
+    assert wq.sharding.spec == P(None, "fsdp", "tp")
+    with mesh:
+        logits_s, ref_s, values_s = jax.jit(
+            lambda p, t, m: policy.forward(p, t, m)
+        )(sharded, tokens, mask)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_s), np.asarray(logits), atol=2e-4
+    )
+    np.testing.assert_allclose(np.asarray(ref_s), np.asarray(ref), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(values_s), np.asarray(values), atol=2e-4
+    )
+
+
+def test_ppo_gptj_config_builds_and_steps_on_mesh(devices):
+    """The shipped ppo_gptj.yml wiring (gptj arch, tp+fsdp mesh) builds a
+    trainer and completes a rollout + train step at toy scale."""
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.load_yaml("configs/ppo_gptj.yml")
+    # toy geometry, real arch + real mesh axes from the shipped config
+    config.model.model_spec = {
+        "arch": "gptj", "vocab_size": 257, "n_layer": 2, "n_head": 4,
+        "d_model": 64, "n_positions": 64, "rotary_dim": 16,
+        "tie_lm_head": False,
+    }
+    config.model.tokenizer_path = "byte"
+    config.model.compute_dtype = "float32"
+    config.train.mesh = {"dp": -1, "fsdp": 2, "tp": 2}
+    config.train.total_steps = 2
+    config.train.epochs = 1
+    config.train.batch_size = 8
+    config.train.input_size = 4
+    config.train.gen_size = 8
+    config.train.log_interval = 1
+    config.train.eval_interval = 10**9
+    config.train.checkpoint_interval = 10**9
+    config.method.num_rollouts = 8
+    config.method.chunk_size = 8
+    config.method.ppo_epochs = 1
+
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert np.isfinite(info["mean_score"])
+    logs = []
+    trainer.learn(log_fn=logs.append)
+    train_logs = [l for l in logs if "loss" in l]
+    assert train_logs and np.isfinite(train_logs[-1]["loss"])

@@ -1,0 +1,134 @@
+"""Learned reward model, co-resident on the mesh.
+
+The reference's reward path is a HOST callback — an HF sentiment pipeline
+on CPU (reference: examples/ppo_sentiments.py:16-28), which the rollout
+loop round-trips through every chunk. For learned-RM workloads (the
+BASELINE TL;DR summarization target: a reward model co-resident with the
+policy on the mesh) that round trip is unnecessary: the RM here is a
+functional trunk + scalar head living on the same mesh as the policy,
+scored by a jitted forward — rollout scoring then costs ZERO extra
+host<->device transfers (the scores ride the orchestrator's single
+per-chunk device_get).
+
+`DeviceRewardModel` also satisfies the plain `reward_fn(List[str])`
+protocol (tokenize on host, score on device), so eval paths and user code
+that expect the reference contract work unchanged.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import ModelSpec
+from trlx_tpu.models.heads import head_apply, init_head_params
+from trlx_tpu.models.transformer import (
+    apply_blocks,
+    causal_mask_bias,
+    embed_tokens,
+    init_block_params,
+    init_embed_params,
+    init_ln_f_params,
+    layer_norm,
+    positions_from_mask,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class RewardModel:
+    """Trunk + scalar head; `score` reads the last real token's hidden
+    state (the sequence-summary convention learned RMs train with)."""
+
+    spec: ModelSpec
+    compute_dtype: Any = jnp.bfloat16
+
+    def init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        k_embed, k_blocks, k_head = jax.random.split(rng, 3)
+        embed = init_embed_params(k_embed, self.spec, param_dtype)
+        embed.pop("lm_head", None)  # no LM head on a reward model
+        return {
+            "embed": embed,
+            "blocks": init_block_params(
+                k_blocks, self.spec, self.spec.n_layer, param_dtype
+            ),
+            "ln_f": init_ln_f_params(self.spec, param_dtype),
+            "r_head": init_head_params(k_head, self.spec.d_model, 1,
+                                       param_dtype),
+        }
+
+    def from_trunk(self, embed: Params, blocks: Params, ln_f: Params,
+                   head_rng: jax.Array, param_dtype=jnp.float32) -> Params:
+        """Params from an imported pretrained trunk (hf_import layout) with
+        a fresh scalar head — how learned RMs are typically initialized."""
+        embed = dict(embed)
+        embed.pop("lm_head", None)
+        return {
+            "embed": embed,
+            "blocks": blocks,
+            "ln_f": ln_f,
+            "r_head": init_head_params(head_rng, self.spec.d_model, 1,
+                                       param_dtype),
+        }
+
+    def score(self, params: Params, tokens: jnp.ndarray,
+              attention_mask: jnp.ndarray) -> jnp.ndarray:
+        """[B] float32 scalar rewards for (left- or right-padded) sequences."""
+        positions = positions_from_mask(attention_mask)
+        mask_bias = causal_mask_bias(attention_mask)
+        h = embed_tokens(params["embed"], self.spec, tokens, positions,
+                         self.compute_dtype)
+        h = apply_blocks(params["blocks"], self.spec, h, mask_bias, positions)
+        h = layer_norm(params["ln_f"], h, self.spec.layer_norm_epsilon)
+        # hidden state of the last REAL token per row: the highest index
+        # with mask == 1 (NOT sum-1, which is wrong under the left padding
+        # this codebase's tokenizers and generate() produce)
+        T = attention_mask.shape[-1]
+        last = T - 1 - jnp.argmax(attention_mask[:, ::-1], axis=-1)
+        h_last = jnp.take_along_axis(
+            h, last[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        return head_apply(params["r_head"], h_last)[:, 0]
+
+
+class DeviceRewardModel:
+    """A mesh-resident reward model usable wherever a `reward_fn` is.
+
+    - `score_tokens(tokens, mask)` — jitted device scoring; returns a
+      DEVICE [B] array (the orchestrator folds it into its single
+      per-chunk fetch).
+    - `__call__(texts)` — the reference host contract: tokenize, score on
+      device, return floats (used by eval paths).
+    """
+
+    is_device_reward = True
+
+    def __init__(self, model: RewardModel, params: Params, tokenizer,
+                 mesh=None, max_length: int = 512):
+        from trlx_tpu.parallel import shard_params
+
+        self.model = model
+        self.tokenizer = tokenizer
+        self.max_length = max_length
+        self.mesh = mesh
+        if mesh is not None:
+            params = shard_params(mesh, params)
+        self.params = params
+        self._jit_score = jax.jit(model.score)
+
+    def score_tokens(self, tokens, attention_mask):
+        return self._jit_score(self.params, tokens, attention_mask)
+
+    def __call__(self, texts):
+        enc = self.tokenizer(
+            list(texts), max_length=self.max_length, padding="max_length",
+            truncation=True,
+        )
+        scores = self.score_tokens(
+            jnp.asarray(np.asarray(enc["input_ids"], np.int32)),
+            jnp.asarray(np.asarray(enc["attention_mask"], np.int32)),
+        )
+        return np.asarray(jax.device_get(scores), np.float32).tolist()

@@ -95,6 +95,24 @@ class PPOOrchestrator(Orchestrator):
             scored = trainer.score_experience(
                 gen.sequences, gen.attention_mask, gen.gen_mask
             )
+            # a mesh-resident learned reward model scores the raw token
+            # sequences on device — zero extra transfers (the scores ride
+            # the same batched fetch below); host reward_fns get decoded
+            # texts, the reference contract
+            device_reward = getattr(self.reward_fn, "is_device_reward", False)
+            if device_reward:
+                # the RM must see the TRUE response validity: gen.attention
+                # _mask keeps post-eos pads at 1 (cache-slot validity), so
+                # splice in gen_mask — otherwise early-terminating rows are
+                # summarized at a trailing pad token
+                P = query.shape[1]
+                rm_mask = jax.numpy.concatenate(
+                    [gen.attention_mask[:, :P], gen.gen_mask], axis=1
+                )
+                scores_dev = self.reward_fn.score_tokens(gen.sequences,
+                                                         rm_mask)
+            else:
+                scores_dev = ()
             if i + 1 < n_chunks:
                 q2, m2 = self._next_prompts()
                 pending = (q2, m2, trainer.generate(q2, m2))
@@ -102,16 +120,22 @@ class PPOOrchestrator(Orchestrator):
             # ONE batched device->host fetch per chunk: per-array pulls
             # each pay a full host<->device round trip (dominant on
             # tunneled/remote device topologies)
-            (sequences, gen_mask, gen_tokens, logprobs, values, kl_rewards,
-             seq_kl) = jax.device_get(
-                (gen.sequences, gen.gen_mask, gen.gen_tokens) + tuple(scored)
+            fetched = jax.device_get(
+                (gen.sequences, gen.gen_mask, gen.gen_tokens)
+                + tuple(scored)
+                + ((scores_dev,) if device_reward else ())
             )
+            (sequences, gen_mask, gen_tokens, logprobs, values, kl_rewards,
+             seq_kl) = fetched[:7]
             gen_mask = gen_mask.astype(np.int32)
 
-            texts = trainer.tokenizer.batch_decode(
-                sequences, skip_special_tokens=True
-            )
-            scores = self.score(texts)
+            if device_reward:
+                scores = np.asarray(fetched[7], np.float32)
+            else:
+                texts = trainer.tokenizer.batch_decode(
+                    sequences, skip_special_tokens=True
+                )
+                scores = self.score(texts)
             all_scores.append(scores)
 
             # score lands on each row's last REAL response token (parity:
@@ -133,7 +157,7 @@ class PPOOrchestrator(Orchestrator):
                 query_masks=np.asarray(qmask, np.int32),
             )
             trainer.push_to_store(batch)
-            self.clock.tick(len(texts))
+            self.clock.tick(len(sequences))
 
         # adaptive KL update from measured KL (parity: reference
         # accelerate_ppo_model.py:205 -> 130-135)
