@@ -41,21 +41,30 @@ def synthetic_documents(n=256, seed=0):
     return docs
 
 
-def build_reward_model(config, trainer, trunk=None):
-    """RM co-resident on the trainer's mesh. Online (`trunk` given, loaded
-    once by main's availability probe): pretrained trunk + fresh scalar
-    head; offline: from-config trunk (same wiring)."""
+def build_reward_model(config, trainer):
+    """RM co-resident on the trainer's mesh, initialized from the trainer's
+    OWN already-loaded trunk — the checkpoint is read exactly once (at 6B
+    scale a second host copy would double peak RAM). With a from-config
+    trainer this reuses its random-init trunk; either way the RM gets a
+    fresh scalar head (stand-in for a trained RM checkpoint)."""
+    import jax.numpy as jnp
+
     spec = trainer.policy.spec
     model = RewardModel(
         spec=spec,
         compute_dtype=trainer.policy.compute_dtype,
     )
-    if trunk is not None:
-        _, embed, blocks, ln_f = trunk
-        params = model.from_trunk(embed, blocks, ln_f,
-                                  jax.random.PRNGKey(1))
-    else:
-        params = model.init(jax.random.PRNGKey(1))
+    p = trainer.params
+    embed = dict(p["frozen_base"]["embed"])
+    blocks = trainer.policy.all_blocks(p)  # bottom ++ top = full trunk
+    ln_f = p["trainable"]["ln_f"]
+    params = model.from_trunk(embed, blocks, ln_f, jax.random.PRNGKey(1))
+    if trainer.mesh is None:
+        # decouple from the trainer's buffers: its train step DONATES
+        # params, which would invalidate aliased RM leaves (under a mesh,
+        # DeviceRewardModel's shard_params already copies)
+        params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                        params)
     return DeviceRewardModel(
         model, params, trainer.tokenizer, mesh=trainer.mesh,
         max_length=config.train.input_size + config.train.gen_size,
@@ -70,12 +79,15 @@ def main():
     args = parser.parse_args()
     config = TRLConfig.load_yaml(args.config)
 
-    trunk = None
+    offline = False
     try:
-        from trlx_tpu.models.hf_import import load_trunk_from_hf
-
-        trunk = load_trunk_from_hf(config.model.model_path)
-    except Exception:
+        # pretrained path: the trainer loads the checkpoint (once); the RM
+        # below reuses that trunk
+        trainer = get_model(config.model.model_type)(config)
+    except RuntimeError as e:
+        offline = True
+        print(f"pretrained load unavailable ({e}); "
+              f"running the offline synthetic fallback", file=sys.stderr)
         # offline fallback: tiny from-config policy, byte tokenizer,
         # short synthetic documents
         config.model.model_spec = {
@@ -95,14 +107,14 @@ def main():
         config.train.log_interval = 4
         config.train.eval_interval = 10**9
         config.train.checkpoint_interval = 10**9
+        trainer = get_model(config.model.model_type)(config)
 
-    trainer = get_model(config.model.model_type)(config)
-    if trunk is None:
+    if offline:
         from trlx_tpu.utils.tokenizer import ByteTokenizer
 
         trainer.tokenizer = ByteTokenizer()
 
-    reward_model = build_reward_model(config, trainer, trunk=trunk)
+    reward_model = build_reward_model(config, trainer)
     prompts = synthetic_documents()
     pipeline = get_pipeline(config.train.pipeline)(
         prompts, trainer.tokenizer, config

@@ -160,3 +160,21 @@ def test_shipped_configs_load_and_registries_resolve():
 
             # mesh axes must be resolvable on an 8-device pod slice
             resolve_axis_sizes(cfg.train.mesh, 8)
+
+
+def test_debug_nans_flag_enables_jax_config(monkeypatch):
+    """train.debug_nans: true flips jax_debug_nans at trainer build."""
+    import jax
+
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.utils.loading import get_model
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = make_config(total_steps=1, epochs=1)
+    config.train.debug_nans = True
+    try:
+        trainer = get_model(config.model.model_type)(config)
+        trainer.tokenizer = ByteTokenizer()
+        assert jax.config.jax_debug_nans
+    finally:
+        jax.config.update("jax_debug_nans", False)

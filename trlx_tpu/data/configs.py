@@ -133,6 +133,9 @@ class TrainConfig:
         -1 means "all remaining devices"
     :param seed: global PRNG seed (JAX is explicit about randomness)
     :param remat: rematerialize transformer blocks in the backward pass
+    :param debug_nans: enable jax_debug_nans — jitted programs fail fast at
+        the op that produced a NaN instead of training on garbage (SURVEY
+        §5 sanitizer gap; costs recompiles + sync, debug only)
     """
 
     n_ctx: int
@@ -169,6 +172,7 @@ class TrainConfig:
     seed: int = 0
     remat: bool = False
     checkpoint_dir: str = "ckpts"
+    debug_nans: bool = False
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -119,18 +119,18 @@ class PPOOrchestrator(Orchestrator):
 
             # ONE batched device->host fetch per chunk: per-array pulls
             # each pay a full host<->device round trip (dominant on
-            # tunneled/remote device topologies)
-            fetched = jax.device_get(
-                (gen.sequences, gen.gen_mask, gen.gen_tokens)
-                + tuple(scored)
-                + ((scores_dev,) if device_reward else ())
+            # tunneled/remote device topologies). Nested structure, so the
+            # unpacking can't silently shift if score_experience grows.
+            gen_host, scored_host, scores_host = jax.device_get(
+                ((gen.sequences, gen.gen_mask, gen.gen_tokens),
+                 tuple(scored), scores_dev)
             )
-            (sequences, gen_mask, gen_tokens, logprobs, values, kl_rewards,
-             seq_kl) = fetched[:7]
+            sequences, gen_mask, gen_tokens = gen_host
+            logprobs, values, kl_rewards, seq_kl = scored_host
             gen_mask = gen_mask.astype(np.int32)
 
             if device_reward:
-                scores = np.asarray(fetched[7], np.float32)
+                scores = np.asarray(scores_host, np.float32)
             else:
                 texts = trainer.tokenizer.batch_decode(
                     sequences, skip_special_tokens=True

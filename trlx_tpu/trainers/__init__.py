@@ -39,6 +39,10 @@ class BaseRLTrainer:
         self.config = config
         self.train_mode = train_mode
         self.store = None
+        if getattr(config.train, "debug_nans", False):
+            import jax
+
+            jax.config.update("jax_debug_nans", True)
         # multi-host bootstrap first (no-op single-process), so the mesh
         # sees the pod's global device list
         initialize_runtime()
