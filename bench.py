@@ -226,7 +226,7 @@ def bench_long_context(peak, T=4096, B=2):
     params, opt_state, _ = trainer._train_step(
         trainer.params, trainer.opt_state, jbatch
     )  # compile
-    np.asarray(jax.tree_util.tree_leaves(params)[0])[:1]
+    np.asarray(jax.tree_util.tree_leaves(params)[0][:1])  # device-side slice
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
@@ -250,6 +250,80 @@ def bench_long_context(peak, T=4096, B=2):
         "long_ctx_mfu": round(mfu, 4) if mfu else None,
         "long_ctx_fused_attention": fused,
     }
+
+
+def bench_gpt2_xl():
+    """The BASELINE.md north-star model: ppo_sentiments at gpt2-xl (1.5B)
+    scale, same workload shape, on the one chip. Guarded — the headline
+    bench must survive an OOM/compile failure here."""
+    import jax
+    import numpy as np
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": "from-config",
+                "tokenizer_path": "byte",
+                "model_type": "JaxPPOTrainer",
+                "num_layers_unfrozen": 2,
+                "model_spec": {  # gpt2-xl geometry
+                    "vocab_size": 50257, "n_layer": 48, "n_head": 25,
+                    "d_model": 1600, "n_positions": 1024,
+                },
+                "compute_dtype": "bfloat16",
+            },
+            "train": {
+                "n_ctx": 512, "epochs": 1, "total_steps": 4,
+                "batch_size": 128, "grad_clip": 1.0, "lr_ramp_steps": 100,
+                "lr_decay_steps": 79000, "weight_decay": 1e-6,
+                "learning_rate_init": 1.412e-4,
+                "learning_rate_target": 1.412e-4, "log_interval": 10**9,
+                "checkpoint_interval": 10**9, "eval_interval": 10**9,
+                "pipeline": "PPOPipeline", "orchestrator": "PPOOrchestrator",
+                "input_size": 4, "gen_size": 48, "seed": 0,
+            },
+            "method": {
+                "name": "ppoconfig", "num_rollouts": 128, "chunk_size": 128,
+                "ppo_epochs": 4,
+                "gen_kwargs": {"max_length": 48, "min_length": 48,
+                               "top_k": 0, "top_p": 1.0, "do_sample": True},
+            },
+        }
+    )
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    rng = np.random.default_rng(0)
+    prompts = ["".join(chr(c) for c in rng.integers(97, 123, size=16))
+               for _ in range(256)]
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=lambda ts: [0.5] * len(ts),
+        chunk_size=128,
+    )
+    orch.make_experience(128)  # compile
+    trainer.learn(log_fn=lambda s: None)
+    np.asarray(jax.tree_util.tree_leaves(trainer.params)[0][:1])
+    cycles = []
+    for _ in range(2):
+        trainer.store.clear_history()
+        trainer.iter_count = 0
+        trainer.epoch = 0
+        t0 = time.perf_counter()
+        orch.make_experience(128)
+        trainer.learn(log_fn=lambda s: None)
+        np.asarray(jax.tree_util.tree_leaves(trainer.params)[0][:1])
+        cycles.append(time.perf_counter() - t0)
+    sps = 128 / min(cycles)
+    log(f"gpt2-xl (1.5B) ppo cycle: {min(cycles):.2f}s -> "
+        f"{sps:.1f} samples/s/chip")
+    return {"xl_samples_per_sec": round(sps, 2),
+            "xl_workload": "ppo_sentiments gpt2-xl-1.5B b128 4+48tok"}
 
 
 def main():
@@ -316,7 +390,18 @@ def main():
         f"{f', MFU {train_mfu:.1%}' if train_mfu else ''}")
 
     # ---- long-context train step (fused Pallas attention path) -----------
-    long_ctx = bench_long_context(peak)
+    try:
+        long_ctx = bench_long_context(peak)
+    except Exception as e:  # must not sink the headline metric
+        log(f"long-context bench skipped: {e!r}")
+        long_ctx = {}
+
+    # ---- gpt2-xl (the BASELINE north-star model) --------------------------
+    try:
+        xl = bench_gpt2_xl()
+    except Exception as e:
+        log(f"gpt2-xl bench skipped: {e!r}")
+        xl = {}
 
     # ---- full rollout+update cycles (the headline) -----------------------
     cycles = 3
@@ -355,6 +440,7 @@ def main():
         "exp_time_sec": round(min(exp_times), 3),
         "update_time_sec": round(best - min(exp_times), 3),
         **long_ctx,
+        **xl,
     }
     print(json.dumps(result), flush=True)
 

@@ -58,13 +58,9 @@ def build_reward_model(config, trainer):
     embed = dict(p["frozen_base"]["embed"])
     blocks = trainer.policy.all_blocks(p)  # bottom ++ top = full trunk
     ln_f = p["trainable"]["ln_f"]
+    # DeviceRewardModel deep-copies, decoupling the RM from the trainer's
+    # donated buffers
     params = model.from_trunk(embed, blocks, ln_f, jax.random.PRNGKey(1))
-    if trainer.mesh is None:
-        # decouple from the trainer's buffers: its train step DONATES
-        # params, which would invalidate aliased RM leaves (under a mesh,
-        # DeviceRewardModel's shard_params already copies)
-        params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
-                                        params)
     return DeviceRewardModel(
         model, params, trainer.tokenizer, mesh=trainer.mesh,
         max_length=config.train.input_size + config.train.gen_size,

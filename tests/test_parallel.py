@@ -282,3 +282,13 @@ def test_ppo_gptj_config_builds_and_steps_on_mesh(devices):
     trainer.learn(log_fn=logs.append)
     train_logs = [l for l in logs if "loss" in l]
     assert train_logs and np.isfinite(train_logs[-1]["loss"])
+
+
+def test_sharded_ilql_e2e_smoke(devices):
+    """ILQL offline flow (store -> jitted loss/update/Polyak sync) on the
+    full (dp, fsdp, sp, tp) mesh — the dryrun's second leg as a test."""
+    import __graft_entry__
+
+    mesh = build_mesh({"dp": -1, "fsdp": 2, "sp": 2, "tp": 2})
+    steps = __graft_entry__._dryrun_ilql(mesh)
+    assert steps > 0

@@ -141,3 +141,46 @@ def test_ppo_e2e_with_coresident_reward_model(devices):
     trainer.learn(log_fn=logs.append)
     train_logs = [l for l in logs if "loss" in l]
     assert train_logs and np.isfinite(train_logs[-1]["loss"])
+
+
+def test_rm_survives_trainer_param_donation(devices):
+    """Regression (review-found): an RM built from the trainer's OWN trunk
+    must not alias the trainer's buffers — train steps donate params, and
+    aliased RM leaves would be deleted after the first update."""
+    from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+
+    config = make_config(
+        total_steps=4, epochs=2, num_rollouts=16, chunk_size=16,
+        batch_size=16, ppo_epochs=1,
+    )
+    config.train.mesh = {"dp": -1}  # mesh set, like the shipped configs
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+
+    spec = trainer.policy.spec
+    model = RewardModel(spec=spec, compute_dtype=jnp.float32)
+    params = model.from_trunk(
+        dict(trainer.params["frozen_base"]["embed"]),
+        trainer.policy.all_blocks(trainer.params),
+        trainer.params["trainable"]["ln_f"],
+        jax.random.PRNGKey(3),
+    )
+    rm = DeviceRewardModel(model, params, trainer.tokenizer,
+                           mesh=trainer.mesh, max_length=16)
+
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=rm,
+        chunk_size=config.method.chunk_size,
+    )
+    orch.make_experience(config.method.num_rollouts)
+    # learn() donates trainer params each step AND calls back into
+    # make_experience -> rm.score_tokens between epochs; with aliased
+    # buffers this raises "Array has been deleted"
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count > 0
+    out = rm(["still alive"])
+    assert np.isfinite(out).all()

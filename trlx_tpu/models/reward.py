@@ -116,7 +116,15 @@ class DeviceRewardModel:
         self.mesh = mesh
         if mesh is not None:
             params = shard_params(mesh, params)
-        self.params = params
+        # ALWAYS deep-copy: callers commonly build the RM from a trainer's
+        # own trunk (examples/ppo_tldr.py), and trainer train steps DONATE
+        # their params — aliased RM leaves would be deleted after the first
+        # update. device_put/shard_params are no-ops on already-placed
+        # arrays, so an explicit jitted copy (sharding-preserving) is the
+        # only reliable decoupling.
+        self.params = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )(params)
         self._jit_score = jax.jit(model.score)
 
     def score_tokens(self, tokens, attention_mask):

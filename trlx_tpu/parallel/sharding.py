@@ -187,6 +187,12 @@ def shard_batch(mesh: Mesh, tree):
     leaves must share a common leading batch dimension, divisible by
     dp * fsdp (validated here with a config-level error rather than a
     device_put failure mid-rollout).
+
+    Multi-host: every process must pass the SAME global array —
+    guaranteed here because the framework's loaders are seed-deterministic
+    (each host materializes the identical batch and device_put places only
+    its addressable shards). This replicated-loading design replaces the
+    reference's per-rank split DataLoaders (Accelerate's prepare).
     """
     n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
     for leaf in jax.tree_util.tree_leaves(tree):

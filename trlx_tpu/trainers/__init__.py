@@ -39,10 +39,13 @@ class BaseRLTrainer:
         self.config = config
         self.train_mode = train_mode
         self.store = None
-        if getattr(config.train, "debug_nans", False):
-            import jax
+        # set BOTH ways: the flag is process-global, and a True from an
+        # earlier trainer must not leak into later ones
+        import jax
 
-            jax.config.update("jax_debug_nans", True)
+        jax.config.update(
+            "jax_debug_nans", bool(getattr(config.train, "debug_nans", False))
+        )
         # multi-host bootstrap first (no-op single-process), so the mesh
         # sees the pod's global device list
         initialize_runtime()
