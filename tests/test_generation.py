@@ -23,6 +23,8 @@ def setup(arch="gpt2"):
     kw = dict(vocab_size=97, n_layer=3, n_head=4, d_model=64, n_positions=64)
     if arch == "gptj":
         kw.update(rotary_dim=8, tie_lm_head=False)
+    if arch == "llama":
+        kw.update(tie_lm_head=False, n_kv_heads=2)  # GQA decode cache
     spec = ModelSpec(arch=arch, **kw)
     policy = HydraPolicy(spec=spec, num_layers_unfrozen=1, compute_dtype=jnp.float32)
     params = policy.init(jax.random.PRNGKey(0))
@@ -45,7 +47,7 @@ def run_generate(arch, prompt, mask, cfg, seed=0):
 GREEDY = GenerationConfig(gen_size=6, sampling=SamplingParams(do_sample=False))
 
 
-@pytest.mark.parametrize("arch", ["gpt2", "gptj"])
+@pytest.mark.parametrize("arch", ["gpt2", "gptj", "llama"])
 def test_greedy_decode_matches_teacher_forcing(arch):
     """Cache-based decode must agree with a full no-cache forward: feeding
     the generated sequence back through the model, argmax at each position

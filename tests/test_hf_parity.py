@@ -77,3 +77,80 @@ def test_gptneox_parity():
         rotary_pct=0.5,
     )
     check_parity(transformers.GPTNeoXForCausalLM(cfg), TOKENS)
+
+
+def test_llama_parity():
+    cfg = transformers.LlamaConfig(
+        vocab_size=97,
+        max_position_embeddings=64,
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        intermediate_size=128,
+        tie_word_embeddings=False,
+    )
+    check_parity(transformers.LlamaForCausalLM(cfg), TOKENS)
+
+
+def test_llama_gqa_parity():
+    """Grouped-query attention: 4 query heads sharing 2 KV heads."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=97,
+        max_position_embeddings=64,
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=128,
+        rope_theta=500000.0,  # llama-3 value; exercises theta plumbing
+        tie_word_embeddings=False,
+    )
+    check_parity(transformers.LlamaForCausalLM(cfg), TOKENS)
+
+
+@pytest.mark.parametrize("make_cfg", [
+    lambda: transformers.GPT2Config(
+        vocab_size=97, n_positions=64, n_embd=64, n_layer=2, n_head=4
+    ),
+    lambda: transformers.GPTJConfig(
+        vocab_size=97, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8,
+    ),
+    lambda: transformers.LlamaConfig(
+        vocab_size=97, max_position_embeddings=64, hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, tie_word_embeddings=False,
+    ),
+])
+def test_init_tree_matches_import_tree(make_cfg):
+    """Regression (review-found): from-scratch init and HF import must
+    produce STRUCTURALLY identical trunk pytrees — a mismatch (e.g. an
+    extra ln_f bias leaf) breaks checkpoint restore targets and any
+    tree_map between the two paths."""
+    import jax
+
+    from trlx_tpu.models.transformer import (
+        init_block_params,
+        init_embed_params,
+        init_ln_f_params,
+    )
+
+    hf_model = transformers.AutoModelForCausalLM.from_config(make_cfg())
+    spec = hf_import.spec_from_hf_config(hf_model.config)
+    embed_i, blocks_i, ln_f_i = hf_import.convert_state_dict(
+        hf_model.state_dict(), spec
+    )
+    rng = jax.random.PRNGKey(0)
+    embed = init_embed_params(rng, spec)
+    blocks = init_block_params(rng, spec, spec.n_layer)
+    ln_f = init_ln_f_params(spec)
+    for name, a, b in (("embed", embed, embed_i), ("blocks", blocks, blocks_i),
+                       ("ln_f", ln_f, ln_f_i)):
+        sa = jax.tree_util.tree_structure(
+            jax.tree_util.tree_map(lambda x: 0, a)
+        )
+        sb = jax.tree_util.tree_structure(
+            jax.tree_util.tree_map(lambda x: 0, b)
+        )
+        assert sa == sb, f"{name}: init {sa} != import {sb}"

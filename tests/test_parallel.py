@@ -197,11 +197,12 @@ def test_sharded_ppo_e2e_smoke(devices):
     assert meshed.iter_count > 0
 
 
-@pytest.mark.parametrize("arch", ["gptj", "gptneox"])
+@pytest.mark.parametrize("arch", ["gptj", "gptneox", "llama"])
 def test_tp_sharded_forward_matches_dense_other_arches(devices, arch):
     """VERDICT item 6: tensor-parallel forward parity for the gpt-j /
-    gpt-neox families (rotary, parallel blocks, untied heads — the
-    structures the ppo_gptj.yml workload shards over tp)."""
+    gpt-neox / llama families (rotary, parallel blocks, untied heads,
+    GQA + swiglu for llama — the structures larger workloads shard
+    over tp)."""
     import jax.numpy as jnp
 
     from trlx_tpu.data.configs import ModelSpec
@@ -212,6 +213,7 @@ def test_tp_sharded_forward_matches_dense_other_arches(devices, arch):
         arch=arch, vocab_size=64, n_layer=2, n_head=4, d_model=32,
         n_positions=32, rotary_dim=8 if arch == "gptj" else 0,
         tie_lm_head=False,
+        n_kv_heads=2 if arch == "llama" else 0,
     )
     policy = HydraPolicy(
         spec=spec, num_layers_unfrozen=1, compute_dtype=jnp.float32

@@ -29,7 +29,7 @@ class ModelSpec:
     contract when importing pretrained HF weights.
     """
 
-    arch: str = "gpt2"  # gpt2 | gptj | gptneox
+    arch: str = "gpt2"  # gpt2 | gptj | gptneox | llama
     vocab_size: int = 50257
     n_layer: int = 12
     n_head: int = 12
@@ -39,16 +39,24 @@ class ModelSpec:
     rotary_dim: int = 0  # gptj/gptneox: rotary dims per head (0 => head_dim)
     layer_norm_epsilon: float = 1e-5
     tie_lm_head: bool = True  # gpt2 ties lm_head to wte; gptj/neox do not
+    n_kv_heads: int = 0  # grouped-query attention (llama); 0 => n_head
+    rope_theta: float = 10000.0
 
     def __post_init__(self):
         if self.d_ff == 0:
             object.__setattr__(self, "d_ff", 4 * self.d_model)
         if self.d_model % self.n_head != 0:
             raise ValueError("d_model must be divisible by n_head")
+        if self.n_kv_heads and self.n_head % self.n_kv_heads != 0:
+            raise ValueError("n_head must be divisible by n_kv_heads")
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_head
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]) -> "ModelSpec":

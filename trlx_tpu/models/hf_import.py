@@ -10,7 +10,8 @@ Works fully offline against a local checkpoint directory, or against any
 model the local HF cache already holds. Torch is used only on the host for
 deserialization — nothing torch touches the TPU.
 
-Supported arches: gpt2 (incl. gpt2-imdb/xl), gptj (gpt-j-6B), gptneox.
+Supported arches: gpt2 (incl. gpt2-imdb/xl), gptj (gpt-j-6B), gptneox,
+llama (llama-2/-3 families incl. grouped-query attention).
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -63,6 +64,36 @@ def spec_from_hf_config(hf_config) -> ModelSpec:
             ),
             layer_norm_epsilon=hf_config.layer_norm_eps,
             tie_lm_head=False,
+        )
+    if mt == "llama":
+        # fail fast on structures this importer does not (yet) carry —
+        # silently dropping them would produce wrong logits with no error
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError(
+                "llama checkpoints with rope_scaling (llama-3.1+) are not "
+                "supported yet: plain rope frequencies would silently "
+                "diverge from the reference model"
+            )
+        if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False
+        ):
+            raise ValueError(
+                "llama-arch checkpoints with attention_bias/mlp_bias are "
+                "not supported: the converter would silently drop the bias "
+                "tensors"
+            )
+        return ModelSpec(
+            arch="llama",
+            vocab_size=hf_config.vocab_size,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size,
+            n_positions=hf_config.max_position_embeddings,
+            layer_norm_epsilon=hf_config.rms_norm_eps,
+            tie_lm_head=getattr(hf_config, "tie_word_embeddings", False),
+            n_kv_heads=hf_config.num_key_value_heads,
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         )
     raise ValueError(f"unsupported HF model_type '{mt}'")
 
@@ -139,7 +170,6 @@ def convert_gptj_state_dict(sd, spec: ModelSpec) -> Tuple[Params, Params, Params
             "wk": _stack(sd, "transformer.h.{i}.attn.k_proj.weight", L, t),
             "wv": _stack(sd, "transformer.h.{i}.attn.v_proj.weight", L, t),
             "wo": _stack(sd, "transformer.h.{i}.attn.out_proj.weight", L, t),
-            "bo": np.zeros((L, spec.d_model), np.float32),
         },
         "mlp": {
             "w_in": _stack(sd, "transformer.h.{i}.mlp.fc_in.weight", L, t),
@@ -224,10 +254,50 @@ def convert_gptneox_state_dict(sd, spec: ModelSpec) -> Tuple[Params, Params, Par
     return embed, blocks, ln_f
 
 
+def convert_llama_state_dict(sd, spec: ModelSpec) -> Tuple[Params, Params, Params]:
+    """LLaMA: RMSNorm (weight only), unbiased q/k/v/o projections (k/v in
+    compact GQA width), SwiGLU mlp (gate/up/down), untied lm_head. HF's
+    llama uses the half-rotation rotary convention — exactly our
+    interleaved=False path — so weights transpose straight across."""
+    L = spec.n_layer
+    t = np.transpose
+
+    embed = {"wte": _np(sd["model.embed_tokens.weight"])}
+    if not spec.tie_lm_head:
+        embed["lm_head"] = {
+            "w": t(_np(sd["lm_head.weight"])),
+            "b": np.zeros((spec.vocab_size,), np.float32),
+        }
+    blocks = {
+        "ln_1": {
+            "scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L),
+        },
+        "ln_2": {
+            "scale": _stack(
+                sd, "model.layers.{i}.post_attention_layernorm.weight", L
+            ),
+        },
+        "attn": {
+            "wq": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, t),
+            "wk": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, t),
+            "wv": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, t),
+            "wo": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, t),
+        },
+        "mlp": {
+            "w_gate": _stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, t),
+            "w_in": _stack(sd, "model.layers.{i}.mlp.up_proj.weight", L, t),
+            "w_out": _stack(sd, "model.layers.{i}.mlp.down_proj.weight", L, t),
+        },
+    }
+    ln_f = {"scale": _np(sd["model.norm.weight"])}
+    return embed, blocks, ln_f
+
+
 _CONVERTERS = {
     "gpt2": convert_gpt2_state_dict,
     "gptj": convert_gptj_state_dict,
     "gptneox": convert_gptneox_state_dict,
+    "llama": convert_llama_state_dict,
 }
 
 

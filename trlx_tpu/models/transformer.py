@@ -45,6 +45,8 @@ class ArchFlags:
     attn_bias: bool
     separate_mlp_ln: bool  # gpt2/neox: ln_2 feeds the MLP; gptj: shared ln_1
     rotary_interleaved: bool = False  # gptj rotates every-two; neox rotates halves
+    rmsnorm: bool = False  # llama: RMSNorm (scale only, no mean/bias)
+    swiglu: bool = False  # llama: silu(gate) * up MLP instead of gelu
 
     @classmethod
     def for_spec(cls, spec: ModelSpec) -> "ArchFlags":
@@ -55,6 +57,8 @@ class ArchFlags:
             return cls(True, True, False, False, rotary_interleaved=True)
         if arch == "gptneox":
             return cls(True, True, True, True)
+        if arch == "llama":
+            return cls(False, True, False, True, rmsnorm=True, swiglu=True)
         raise ValueError(f"unknown arch '{spec.arch}'")
 
 
@@ -74,6 +78,7 @@ def init_block_params(
     leading axis `n_layers`."""
     flags = ArchFlags.for_spec(spec)
     d, f = spec.d_model, spec.d_ff
+    d_kv = spec.kv_heads * spec.head_dim  # < d under grouped-query attn
     keys = jax.random.split(rng, 8)
     # GPT-2 residual scaling: two residual additions per block.
     resid_scale = 0.02 / max(2 * spec.n_layer, 1) ** 0.5
@@ -82,38 +87,45 @@ def init_block_params(
         shape, key = shape_key
         return jnp.stack([initer(k, shape) for k in jax.random.split(key, n_layers)])
 
+    def norm_params():
+        p = {"scale": jnp.ones((n_layers, d), dtype)}
+        if not flags.rmsnorm:
+            p["bias"] = jnp.zeros((n_layers, d), dtype)
+        return p
+
     blocks: Params = {
-        "ln_1": {
-            "scale": jnp.ones((n_layers, d), dtype),
-            "bias": jnp.zeros((n_layers, d), dtype),
-        },
+        "ln_1": norm_params(),
         "attn": {
             "wq": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[0]),
-            "wk": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[1]),
-            "wv": stack(lambda k, s: _dense_init(k, s, dtype), (d, d), keys[2]),
+            "wk": stack(lambda k, s: _dense_init(k, s, dtype), (d, d_kv), keys[1]),
+            "wv": stack(lambda k, s: _dense_init(k, s, dtype), (d, d_kv), keys[2]),
             "wo": stack(
                 lambda k, s: _dense_init(k, s, dtype, resid_scale), (d, d), keys[3]
             ),
         },
         "mlp": {
             "w_in": stack(lambda k, s: _dense_init(k, s, dtype), (d, f), keys[4]),
-            "b_in": jnp.zeros((n_layers, f), dtype),
             "w_out": stack(
                 lambda k, s: _dense_init(k, s, dtype, resid_scale), (f, d), keys[5]
             ),
-            "b_out": jnp.zeros((n_layers, d), dtype),
         },
     }
+    if flags.swiglu:
+        blocks["mlp"]["w_gate"] = stack(
+            lambda k, s: _dense_init(k, s, dtype), (d, f), keys[6]
+        )
+    else:  # biased gelu MLP (gpt2/gptj/neox)
+        blocks["mlp"]["b_in"] = jnp.zeros((n_layers, f), dtype)
+        blocks["mlp"]["b_out"] = jnp.zeros((n_layers, d), dtype)
     if flags.attn_bias:
+        # biased attention (gpt2, neox) biases ALL four projections; gptj
+        # and llama bias none — one flag states the real structure
         blocks["attn"]["bq"] = jnp.zeros((n_layers, d), dtype)
-        blocks["attn"]["bk"] = jnp.zeros((n_layers, d), dtype)
-        blocks["attn"]["bv"] = jnp.zeros((n_layers, d), dtype)
-    blocks["attn"]["bo"] = jnp.zeros((n_layers, d), dtype)
+        blocks["attn"]["bk"] = jnp.zeros((n_layers, d_kv), dtype)
+        blocks["attn"]["bv"] = jnp.zeros((n_layers, d_kv), dtype)
+        blocks["attn"]["bo"] = jnp.zeros((n_layers, d), dtype)
     if flags.separate_mlp_ln:
-        blocks["ln_2"] = {
-            "scale": jnp.ones((n_layers, d), dtype),
-            "bias": jnp.zeros((n_layers, d), dtype),
-        }
+        blocks["ln_2"] = norm_params()
     return blocks
 
 
@@ -134,10 +146,10 @@ def init_embed_params(rng: jax.Array, spec: ModelSpec, dtype=jnp.float32) -> Par
 
 
 def init_ln_f_params(spec: ModelSpec, dtype=jnp.float32) -> Params:
-    return {
-        "scale": jnp.ones((spec.d_model,), dtype),
-        "bias": jnp.zeros((spec.d_model,), dtype),
-    }
+    p: Params = {"scale": jnp.ones((spec.d_model,), dtype)}
+    if not ArchFlags.for_spec(spec).rmsnorm:  # RMSNorm (llama) has no bias
+        p["bias"] = jnp.zeros((spec.d_model,), dtype)
+    return p
 
 
 # ---------------------------------------------------------------------------
@@ -146,9 +158,18 @@ def init_ln_f_params(spec: ModelSpec, dtype=jnp.float32) -> Params:
 
 
 def layer_norm(p: Params, x: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """LayerNorm in float32 regardless of compute dtype."""
+    """LayerNorm (or RMSNorm) in float32 regardless of compute dtype.
+
+    Dispatches on the param structure: a norm WITHOUT a bias entry is an
+    RMSNorm (llama) — scale * x / sqrt(mean(x^2) + eps), no centering —
+    so every call site (policy/ilql/generation final norms included)
+    handles both families unchanged.
+    """
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
+    if "bias" not in p:  # RMSNorm
+        y = x32 * jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+        return (y * p["scale"].astype(jnp.float32)).astype(dtype)
     mean = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
     y = (x32 - mean) * jax.lax.rsqrt(var + eps)
@@ -173,17 +194,18 @@ def apply_rotary(
     positions: jnp.ndarray,
     rotary_dim: int,
     interleaved: bool = False,
+    theta: float = 10000.0,
 ) -> jnp.ndarray:
     """Rotary position embedding on the first `rotary_dim` dims of each head.
 
     x: [B, T, H, hd]; positions: [B, T]. `interleaved=True` is the GPT-J
-    rotate-every-two convention; False is the GPT-NeoX half-rotation.
+    rotate-every-two convention; False is the GPT-NeoX/llama half-rotation.
     """
     hd = x.shape[-1]
     rot_dim = rotary_dim if rotary_dim > 0 else hd
     x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
     inv_freq = 1.0 / (
-        10000.0 ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim)
+        theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim)
     )
     # [B, T, rot_dim/2]
     freqs = positions[..., None].astype(jnp.float32) * inv_freq
@@ -208,13 +230,30 @@ def attention_scores(
 ) -> jnp.ndarray:
     """Plain attention: softmax in f32, matmuls in input dtype (bf16 on MXU).
 
-    q: [B, Tq, H, hd]; k, v: [B, Tk, H, hd]; mask_bias: [B, 1, Tq, Tk].
+    q: [B, Tq, H, hd]; k, v: [B, Tk, Hkv, hd] with Hkv dividing H
+    (grouped-query attention runs natively against the compact KV — no
+    repeated copies); mask_bias: [B, 1, Tq, Tk].
     """
-    hd = q.shape[-1]
+    B, Tq, H, hd = q.shape
+    Hkv = k.shape[2]
+    scale = jax.lax.rsqrt(jnp.float32(hd))
+    if Hkv != H:  # GQA: group query heads over each shared KV head
+        g = H // Hkv
+        qg = q.reshape(B, Tq, Hkv, g, hd)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+        scores = scores * scale + mask_bias[:, :, None]
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        return out.reshape(B, Tq, H, hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(hd)) + mask_bias
+    scores = scores * scale + mask_bias
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# grouped-query attention handled natively (compact Hkv-wide k/v accepted);
+# attention fns WITHOUT this attr get H-wide k/v expanded by block_apply
+attention_scores.supports_gqa = True
 
 
 def _project(x, w, b=None):
@@ -254,16 +293,28 @@ def block_apply(
     """
     B, T, D = h.shape
     H, hd = spec.n_head, spec.head_dim
+    Hkv = spec.kv_heads
     eps = spec.layer_norm_epsilon
 
     x = layer_norm(p["ln_1"], h, eps)
     attn = p["attn"]
     q = _project(x, attn["wq"], attn.get("bq")).reshape(B, T, H, hd)
-    k = _project(x, attn["wk"], attn.get("bk")).reshape(B, T, H, hd)
-    v = _project(x, attn["wv"], attn.get("bv")).reshape(B, T, H, hd)
+    k = _project(x, attn["wk"], attn.get("bk")).reshape(B, T, Hkv, hd)
+    v = _project(x, attn["wv"], attn.get("bv")).reshape(B, T, Hkv, hd)
     if flags.use_rotary:
-        q = apply_rotary(q, positions, spec.rotary_dim, flags.rotary_interleaved)
-        k = apply_rotary(k, positions, spec.rotary_dim, flags.rotary_interleaved)
+        q = apply_rotary(q, positions, spec.rotary_dim,
+                         flags.rotary_interleaved, spec.rope_theta)
+        k = apply_rotary(k, positions, spec.rotary_dim,
+                         flags.rotary_interleaved, spec.rope_theta)
+
+    def expand_kv(t):
+        """H-wide KV for attention fns that can't consume the compact GQA
+        form (ring/pallas); the default dense path handles Hkv natively and
+        never materializes the repeat. The cache always stores the compact
+        Hkv form — GQA's memory win."""
+        if Hkv == H or getattr(attention_fn, "supports_gqa", False):
+            return t
+        return jnp.repeat(t, H // Hkv, axis=2)
 
     new_cache = None
     if kv_cache is not None:
@@ -275,29 +326,34 @@ def block_apply(
             v_cache, v.astype(v_cache.dtype), cache_offset, axis=1
         )
         new_cache = (k_full, v_full)
-        a = attention_fn(q, k_full.astype(q.dtype), v_full.astype(q.dtype), mask_bias)
+        a = attention_fn(
+            q,
+            expand_kv(k_full.astype(q.dtype)),
+            expand_kv(v_full.astype(q.dtype)),
+            mask_bias,
+        )
     else:
-        a = attention_fn(q, k, v, mask_bias)
+        a = attention_fn(q, expand_kv(k), expand_kv(v), mask_bias)
 
     a = _project(a.reshape(B, T, D), attn["wo"], attn.get("bo"))
 
+    def mlp(mlp_in):
+        mp = p["mlp"]
+        if flags.swiglu:
+            gate = jax.nn.silu(_project(mlp_in, mp["w_gate"]))
+            return _project(gate * _project(mlp_in, mp["w_in"]), mp["w_out"])
+        return _project(
+            gelu_new(_project(mlp_in, mp["w_in"], mp["b_in"])),
+            mp["w_out"],
+            mp["b_out"],
+        )
+
     if flags.parallel_block:
         mlp_in = layer_norm(p["ln_2"], h, eps) if flags.separate_mlp_ln else x
-        m = _project(
-            gelu_new(_project(mlp_in, p["mlp"]["w_in"], p["mlp"]["b_in"])),
-            p["mlp"]["w_out"],
-            p["mlp"]["b_out"],
-        )
-        return h + a + m, new_cache
+        return h + a + mlp(mlp_in), new_cache
 
     h = h + a
-    mlp_in = layer_norm(p["ln_2"], h, eps)
-    m = _project(
-        gelu_new(_project(mlp_in, p["mlp"]["w_in"], p["mlp"]["b_in"])),
-        p["mlp"]["w_out"],
-        p["mlp"]["b_out"],
-    )
-    return h + m, new_cache
+    return h + mlp(layer_norm(p["ln_2"], h, eps)), new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -376,8 +432,9 @@ def init_kv_cache(
     buffer_len: int,
     dtype=jnp.bfloat16,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(k, v) cache buffers of shape [L, B, buffer_len, H, hd]."""
-    shape = (n_layers, batch, buffer_len, spec.n_head, spec.head_dim)
+    """(k, v) cache buffers of shape [L, B, buffer_len, Hkv, hd] — compact
+    KV-head form under grouped-query attention."""
+    shape = (n_layers, batch, buffer_len, spec.kv_heads, spec.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

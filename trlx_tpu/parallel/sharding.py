@@ -38,6 +38,7 @@ _MATRIX_RULES = {
     "wo": P(None, "tp", "fsdp"),
     # mlp [L, D, F] / [L, F, D]
     "w_in": P(None, "fsdp", "tp"),
+    "w_gate": P(None, "fsdp", "tp"),  # llama swiglu gate, column-parallel
     "w_out": P(None, "tp", "fsdp"),
 }
 
