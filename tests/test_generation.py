@@ -47,7 +47,7 @@ def run_generate(arch, prompt, mask, cfg, seed=0):
 GREEDY = GenerationConfig(gen_size=6, sampling=SamplingParams(do_sample=False))
 
 
-@pytest.mark.parametrize("arch", ["gpt2", "gptj", "llama"])
+@pytest.mark.parametrize("arch", ["gpt2", "gptj", "gptneox", "llama"])
 def test_greedy_decode_matches_teacher_forcing(arch):
     """Cache-based decode must agree with a full no-cache forward: feeding
     the generated sequence back through the model, argmax at each position

@@ -81,6 +81,30 @@ class ModelSpec:
                 rotary_dim=64,
                 tie_lm_head=False,
             ),
+            "llama-2-7b": cls(
+                arch="llama",
+                vocab_size=32000,
+                n_layer=32,
+                n_head=32,
+                d_model=4096,
+                d_ff=11008,
+                n_positions=4096,
+                layer_norm_epsilon=1e-5,
+                tie_lm_head=False,
+            ),
+            "llama-3-8b": cls(
+                arch="llama",
+                vocab_size=128256,
+                n_layer=32,
+                n_head=32,
+                n_kv_heads=8,
+                d_model=4096,
+                d_ff=14336,
+                n_positions=8192,
+                rope_theta=500000.0,
+                layer_norm_epsilon=1e-5,
+                tie_lm_head=False,
+            ),
         }
         key = name.lower()
         if key not in presets:
