@@ -294,3 +294,53 @@ def test_sharded_ilql_e2e_smoke(devices):
     mesh = build_mesh({"dp": -1, "fsdp": 2, "sp": 2, "tp": 2})
     steps = __graft_entry__._dryrun_ilql(mesh)
     assert steps > 0
+
+
+def test_ppo_e2e_llama_arch_on_mesh(devices):
+    """PPO rollout + train with the llama family (RMSNorm/SwiGLU/GQA) on
+    the tp+fsdp mesh — the modern-family counterpart of the gptj smoke."""
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict({
+        "model": {
+            "model_path": "from-config", "tokenizer_path": "byte",
+            "model_type": "JaxPPOTrainer", "num_layers_unfrozen": 1,
+            "model_spec": {
+                "arch": "llama", "vocab_size": 257, "n_layer": 2,
+                "n_head": 4, "n_kv_heads": 2, "d_model": 64,
+                "n_positions": 64, "tie_lm_head": False,
+            },
+            "compute_dtype": "float32",
+        },
+        "train": {
+            "n_ctx": 64, "epochs": 1, "total_steps": 2, "batch_size": 8,
+            "grad_clip": 1.0, "lr_ramp_steps": 0, "lr_decay_steps": 2,
+            "weight_decay": 1e-6, "learning_rate_init": 1e-3,
+            "learning_rate_target": 1e-3, "log_interval": 1,
+            "checkpoint_interval": 10**9, "eval_interval": 10**9,
+            "pipeline": "PPOPipeline", "orchestrator": "PPOOrchestrator",
+            "input_size": 4, "gen_size": 8, "seed": 0,
+            "mesh": {"dp": -1, "fsdp": 2, "tp": 2},
+        },
+        "method": {
+            "name": "ppoconfig", "num_rollouts": 8, "chunk_size": 8,
+            "ppo_epochs": 1,
+            "gen_kwargs": {"max_length": 8, "min_length": 8,
+                           "do_sample": True},
+        },
+    })
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert np.isfinite(info["mean_score"])
+    logs = []
+    trainer.learn(log_fn=logs.append)
+    train_logs = [l for l in logs if "loss" in l]
+    assert train_logs and np.isfinite(train_logs[-1]["loss"])
