@@ -13,9 +13,11 @@ torch, SURVEY §2.9); here it is a hand-tiled TPU kernel following
   skipped via the fori_loop bound (half the FLOPs of a dense causal mask).
 - Padding comes in as the raw [B, T] attention mask (1 = real), the same
   contract as trlx_tpu.ops.ring_attention (`takes_raw_mask = True`).
-- Backward is blockwise JAX (lax.scan over KV blocks) wired through
-  jax.custom_vjp: same O(T * block) memory bound, recomputing scores from
-  the saved logsumexp — the standard flash backward, left to XLA to fuse.
+- Backward is two Pallas kernels wired through jax.custom_vjp — a dq pass
+  (grid over query blocks, streaming KV) and a dk/dv pass (grid over KV
+  blocks, streaming Q), each recomputing probabilities from the saved
+  logsumexp and skipping above-diagonal tiles: same O(T * block) memory
+  bound as the forward, no T x T tensor in either direction.
 
 The public entry `flash_attention` pads T to a block multiple, reshapes
 [B, T, H, hd] -> [B*H, T, hd] for the grid, and restores the layout after.
@@ -188,11 +190,146 @@ def _flash_forward(q, k, v, kv_mask, block_q, block_k, causal):
 
 
 # --------------------------------------------------------------------- #
-# blockwise backward (JAX; same O(T * block) memory bound)
+# backward kernels (same O(T * block) memory bound as the forward)
 # --------------------------------------------------------------------- #
 
 
-def _flash_backward(res, g, block_k, causal):
+def _flash_bwd_dq_kernel(
+    q_ref,  # [1, BQ, hd] (input dtype; scaled in-kernel)
+    k_ref,  # [1, Tp, hd]
+    v_ref,  # [1, Tp, hd]
+    g_ref,  # [1, BQ, hd]
+    lse_ref,  # [1, 1, BQ]
+    dD_ref,  # [1, 1, BQ]  (rowsum(dO * O))
+    mask_ref,  # [1, 1, Tp]
+    dq_ref,  # [1, BQ, hd]
+    *,
+    block_k: int,
+    causal: bool,
+    scale: float,
+):
+    iq = pl.program_id(1)
+    BQ = q_ref.shape[1]
+    Tp = k_ref.shape[1]
+    hd = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]  # [BQ, 1]
+    dD = dD_ref[0, 0][:, None]
+    q_pos = iq * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    n_kv = Tp // block_k
+    if causal:
+        num_live = jax.lax.min(n_kv, pl.cdiv((iq + 1) * BQ, block_k))
+    else:
+        num_live = n_kv
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kv_mask = mask_ref[0, :, pl.ds(j * block_k, block_k)]  # [1, BK]
+
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        bias = jnp.where(kv_mask > 0, 0.0, NEG_INF)
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            bias = bias + jnp.where(q_pos >= kv_pos, 0.0, NEG_INF)
+        p = jnp.exp(s + bias - lse)  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dD)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, num_live, body, jnp.zeros((BQ, hd), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,  # [1, Tp, hd] (input dtype; scaled in-kernel)
+    k_ref,  # [1, BK, hd]
+    v_ref,  # [1, BK, hd]
+    g_ref,  # [1, Tp, hd]
+    lse_ref,  # [1, 1, Tp]
+    dD_ref,  # [1, 1, Tp]
+    mask_ref,  # [1, 1, BK]
+    dk_ref,  # [1, BK, hd]
+    dv_ref,  # [1, BK, hd]
+    *,
+    block_q: int,
+    causal: bool,
+    scale: float,
+):
+    jk = pl.program_id(1)
+    BK = k_ref.shape[1]
+    Tp = q_ref.shape[1]
+    hd = k_ref.shape[2]
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    kv_mask = mask_ref[0]  # [1, BK]
+    kv_pos = jk * BK + jax.lax.broadcasted_iota(jnp.int32, (1, BK), 1)
+
+    n_q = Tp // block_q
+    # causal: query blocks strictly before this KV block see none of it
+    first_live = (jk * BK) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32
+        ) * scale
+        g_blk = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]  # [BQ, 1]
+        dD = dD_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        bias = jnp.where(kv_mask > 0, 0.0, NEG_INF)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            bias = bias + jnp.where(q_pos >= kv_pos, 0.0, NEG_INF)
+        p = jnp.exp(s + bias - lse)
+        dv = dv + jax.lax.dot_general(
+            p, g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dD)
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        first_live, n_q, body,
+        (jnp.zeros((BK, hd), jnp.float32), jnp.zeros((BK, hd), jnp.float32)),
+    )
+    # dk is w.r.t. the pre-scaled s = (q*scale) k^T with q already scaled,
+    # so no extra factor here
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, block_q, block_k, causal):
     q, k, v, kv_mask, out, lse = res
     B, T, H, hd = q.shape
     scale = 1.0 / (hd**0.5)
@@ -201,75 +338,92 @@ def _flash_backward(res, g, block_k, causal):
     def pad(x):
         return _pad_t(x, Tp, 1)
 
-    q32 = pad(q).astype(jnp.float32) * scale
-    k32 = pad(k).astype(jnp.float32)
-    v32 = pad(v).astype(jnp.float32)
-    g32 = pad(g).astype(jnp.float32)
-    maskf = pad(kv_mask)
-    lse_q = lse[..., None]  # [B, H, Tp, 1]
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, Tp, hd)
+
+    # keep inputs in their storage dtype (bf16 halves the VMEM footprint
+    # of the full-length refs); kernels cast per block and scale q inside
+    qf, kf, vf, gf = flat(pad(q)), flat(pad(k)), flat(pad(v)), flat(pad(g))
+    lse_f = lse.reshape(B * H, 1, Tp)
     # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term
-    D = (g32 * pad(out).astype(jnp.float32)).sum(-1).transpose(0, 2, 1)[
-        ..., None
-    ]  # [B, H, Tp, 1]
+    dD = (
+        (gf.astype(jnp.float32) * flat(pad(out)).astype(jnp.float32))
+        .sum(-1)
+        .reshape(B * H, 1, Tp)
+    )
+    maskf = pad(kv_mask)[:, None, :]  # [B, 1, Tp]
 
-    n_blocks = Tp // block_k
-    blk_pos = jnp.arange(block_k)
-
-    # iterate only the live (query block, kv block) tile pairs — causal
-    # skips the above-diagonal half, matching the forward's num_live bound
-    if causal:
-        pairs = [(i, j) for i in range(n_blocks) for j in range(i + 1)]
-    else:
-        pairs = [(i, j) for i in range(n_blocks) for j in range(n_blocks)]
-    pair_idx = jnp.asarray(pairs, jnp.int32)  # [P, 2]
-
-    def slice_q(x, i):
-        return jax.lax.dynamic_slice_in_dim(x, i * block_k, block_k, 1)
-
-    def body(carry, pair):
-        dq, dk, dv = carry
-        i, j = pair[0], pair[1]
-        q_blk = slice_q(q32, i)
-        g_blk = slice_q(g32, i)
-        lse_blk = jax.lax.dynamic_slice_in_dim(lse_q, i * block_k, block_k, 2)
-        D_blk = jax.lax.dynamic_slice_in_dim(D, i * block_k, block_k, 2)
-        k_blk = slice_q(k32, j)
-        v_blk = slice_q(v32, j)
-        m_blk = slice_q(maskf, j)
-
-        s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk)
-        bias = jnp.where(m_blk[:, None, None, :] > 0, 0.0, NEG_INF)
-        if causal:
-            q_pos = i * block_k + blk_pos
-            kv_pos = j * block_k + blk_pos
-            bias = bias + jnp.where(
-                q_pos[:, None] >= kv_pos[None, :], 0.0, NEG_INF
-            )[None, None]
-        p = jnp.exp(s + bias - lse_blk)  # [B, H, BQ, BK]
-
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, v_blk)
-        ds = p * (dp - D_blk)
-        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk) * scale
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk)
-
-        def acc(buf, blk, at):
-            old = jax.lax.dynamic_slice_in_dim(buf, at * block_k, block_k, 1)
-            return jax.lax.dynamic_update_slice_in_dim(
-                buf, old + blk, at * block_k, 1
-            )
-
-        return (acc(dq, dq_blk, i), acc(dk, dk_blk, j), acc(dv, dv_blk, j)), None
-
-    zeros = jnp.zeros((B, Tp, H, hd), jnp.float32)
-    (dq, dk, dv), _ = jax.lax.scan(
-        body, (zeros, zeros, zeros), pair_idx
+    interpret = jax.default_backend() != "tpu"
+    full = lambda: pl.BlockSpec(  # noqa: E731
+        (1, Tp, hd), lambda bh, blk: (bh, 0, 0), memory_space=pltpu.VMEM
+    )
+    blocked = lambda width: pl.BlockSpec(  # noqa: E731
+        (1, width, hd), lambda bh, blk: (bh, blk, 0), memory_space=pltpu.VMEM
+    )
+    row_full = lambda: pl.BlockSpec(  # noqa: E731
+        (1, 1, Tp), lambda bh, blk: (bh, 0, 0), memory_space=pltpu.VMEM
+    )
+    row_blocked = lambda width: pl.BlockSpec(  # noqa: E731
+        (1, 1, width), lambda bh, blk: (bh, 0, blk), memory_space=pltpu.VMEM
+    )
+    mask_spec_full = pl.BlockSpec(
+        (1, 1, Tp), lambda bh, blk, H=H: (bh // H, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    mask_spec_blocked = pl.BlockSpec(
+        (1, 1, block_k), lambda bh, blk, H=H: (bh // H, 0, blk),
+        memory_space=pltpu.VMEM,
     )
 
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(B * H, Tp // block_q),
+        in_specs=[
+            blocked(block_q),  # q
+            full(),  # k
+            full(),  # v
+            blocked(block_q),  # g
+            row_blocked(block_q),  # lse
+            row_blocked(block_q),  # dD
+            mask_spec_full,  # mask
+        ],
+        out_specs=blocked(block_q),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, hd), jnp.float32),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, dD, maskf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
+            scale=scale,
+        ),
+        grid=(B * H, Tp // block_k),
+        in_specs=[
+            full(),  # q
+            blocked(block_k),  # k
+            blocked(block_k),  # v
+            full(),  # g
+            row_full(),  # lse
+            row_full(),  # dD
+            mask_spec_blocked,  # mask
+        ],
+        out_specs=[blocked(block_k), blocked(block_k)],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tp, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, dD, maskf)
+
+    def unflat(x):
+        return x.reshape(B, H, Tp, hd).transpose(0, 2, 1, 3)[:, :T]
+
     return (
-        dq[:, :T].astype(q.dtype),
-        dk[:, :T].astype(k.dtype),
-        dv[:, :T].astype(v.dtype),
+        unflat(dq).astype(q.dtype),
+        unflat(dk).astype(k.dtype),
+        unflat(dv).astype(v.dtype),
         None,
     )
 
@@ -301,7 +455,7 @@ def _fwd(q, k, v, kv_mask, block_q, block_k, causal):
 
 
 def _bwd(block_q, block_k, causal, res, g):
-    return _flash_backward(res, g, block_k, causal)
+    return _flash_backward(res, g, block_q, block_k, causal)
 
 
 flash_attention.defvjp(_fwd, _bwd)

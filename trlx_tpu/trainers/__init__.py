@@ -104,13 +104,13 @@ class BaseRLTrainer:
             n,
         )
 
-    # auto-enable threshold, set from v5e measurements of the full train
-    # step (fwd+bwd): dense wins slightly through 4k (21.8 vs 24.7 ms at
-    # 4096) because the flash backward is blockwise JAX, but collapses at
-    # 8k (707 vs 93 ms — 7.6x) where the T x T score tensors blow past
-    # cache/HBM headroom; the kernel's O(T * block) memory also frees HBM
-    # for batch at any length (force via model.fused_attention: true)
-    FUSED_ATTENTION_MIN_T = 4096
+    # auto-enable threshold, set from v5e measurements of attention
+    # fwd+bwd (both directions Pallas kernels): ~parity with dense at 1k,
+    # ~1.8x at 4k (11 vs 20 ms), ~11x at 8k (62 vs 696 ms) where the
+    # T x T score tensors blow past cache/HBM headroom — and the kernels'
+    # O(T * block) memory frees HBM for batch at any length, so the kernel
+    # engages from the parity point up (force via model.fused_attention)
+    FUSED_ATTENTION_MIN_T = 1024
 
     def _train_attention_fn(self):
         """Attention implementation for train-time forwards, in precedence
