@@ -252,6 +252,75 @@ def bench_long_context(peak, T=4096, B=2):
     }
 
 
+def bench_ilql():
+    """ILQL jitted train step (Q/V/target heads + composite loss) at
+    gpt2-124M geometry on a synthetic offline batch — the offline
+    algorithm's throughput datum. (No MFU figure: the PPO flops model
+    doesn't account for ILQL's vocab-wide Q heads.)"""
+    import jax
+    import numpy as np
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.data.ilql_types import ILQLBatch
+    from trlx_tpu.utils.loading import get_model
+
+    B, T = 64, 48
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": "from-config",
+                "tokenizer_path": "byte",
+                "model_type": "JaxILQLTrainer",
+                "num_layers_unfrozen": -1,
+                "model_spec": {
+                    "vocab_size": 50257, "n_layer": 12, "n_head": 12,
+                    "d_model": 768, "n_positions": 1024,
+                },
+                "compute_dtype": "bfloat16",
+            },
+            "train": {
+                "n_ctx": T, "epochs": 1, "total_steps": 4, "batch_size": B,
+                "grad_clip": 1.0, "lr_ramp_steps": 0, "lr_decay_steps": 4,
+                "weight_decay": 1e-6, "learning_rate_init": 1e-4,
+                "learning_rate_target": 1e-4, "log_interval": 10**9,
+                "checkpoint_interval": 10**9, "eval_interval": 10**9,
+                "pipeline": "OfflinePipeline",
+                "orchestrator": "OfflineOrchestrator",
+                "input_size": 1, "gen_size": T, "seed": 0,
+            },
+            "method": {"name": "ilqlconfig"},
+        }
+    )
+    trainer = get_model(config.model.model_type)(config)
+    rng = np.random.default_rng(0)
+    mask = np.ones((B, T), np.int32)
+    mask[:, -1] = 0  # terminal convention
+    batch = ILQLBatch(
+        input_ids=rng.integers(0, 50257, (B, T)).astype(np.int32),
+        attention_mask=mask,
+        rewards=(rng.normal(size=(B, T - 1)) * 0.01).astype(np.float32),
+    )
+    jbatch = trainer._put(batch)
+    params, opt_state, _ = trainer._train_step(
+        trainer.params, trainer.opt_state, jbatch
+    )  # compile
+    np.asarray(jax.tree_util.tree_leaves(params)[0][:1])
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, opt_state, stats = trainer._train_step(
+            params, opt_state, jbatch
+        )
+    _ = np.asarray(stats["loss"])
+    dt = (time.perf_counter() - t0) / reps
+    log(f"ilql train_step (gpt2-124M, [{B},{T}]): {dt*1e3:.1f} ms "
+        f"({B*T/dt:,.0f} tok/s)")
+    return {
+        "ilql_train_ms": round(dt * 1e3, 1),
+        "ilql_tokens_per_sec": round(B * T / dt, 1),
+    }
+
+
 def bench_gpt2_xl():
     """The BASELINE.md north-star model: ppo_sentiments at gpt2-xl (1.5B)
     scale, same workload shape, on the one chip. Guarded — the headline
@@ -396,6 +465,13 @@ def main():
         log(f"long-context bench skipped: {e!r}")
         long_ctx = {}
 
+    # ---- ILQL train step --------------------------------------------------
+    try:
+        ilql = bench_ilql()
+    except Exception as e:
+        log(f"ilql bench skipped: {e!r}")
+        ilql = {}
+
     # ---- gpt2-xl (the BASELINE north-star model) --------------------------
     try:
         xl = bench_gpt2_xl()
@@ -440,6 +516,7 @@ def main():
         "exp_time_sec": round(min(exp_times), 3),
         "update_time_sec": round(best - min(exp_times), 3),
         **long_ctx,
+        **ilql,
         **xl,
     }
     print(json.dumps(result), flush=True)

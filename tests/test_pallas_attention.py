@@ -68,15 +68,21 @@ def test_flash_with_left_padding():
     )
 
 
-def test_flash_gradients_match_dense():
-    B, T, H, hd = 2, 32, 2, 16
+@pytest.mark.parametrize("T,bq,bk", [
+    (32, 16, 16),   # equal blocks, exact multiple
+    (52, 16, 16),   # T not a block multiple (backward pad/slice path)
+    (32, 16, 8),    # block_q != block_k (dkv kernel's first_live bound)
+    (32, 8, 16),    # block_q != block_k the other way (dq num_live bound)
+])
+def test_flash_gradients_match_dense(T, bq, bk):
+    B, H, hd = 2, 2, 16
     q, k, v = _rand_qkv(jax.random.PRNGKey(3), B, T, H, hd)
     mask = np.ones((B, T), np.int32)
     mask[1, :7] = 0
     mask = jnp.asarray(mask)
 
     def loss_flash(q, k, v):
-        out = flash_attention(q, k, v, mask, 16, 16)
+        out = flash_attention(q, k, v, mask, bq, bk)
         return ((out * mask[:, :, None, None]) ** 2).sum()
 
     def loss_dense(q, k, v):
