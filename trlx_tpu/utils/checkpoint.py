@@ -57,7 +57,15 @@ def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, An
         for name, obj in template.items():
             path = os.path.join(directory, name)
             if os.path.isdir(path):
-                out[name] = ckptr.restore(path, item=obj)
+                # restore WITH the template's shardings: arrays land
+                # directly on the current mesh (and reshard correctly when
+                # restoring onto a different topology than the save ran on)
+                restore_args = ocp.checkpoint_utils.construct_restore_args(
+                    obj
+                )
+                out[name] = ckptr.restore(
+                    path, item=obj, restore_args=restore_args
+                )
             elif name in meta:
                 out[name] = meta[name]
             else:
