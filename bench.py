@@ -529,7 +529,12 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as e:
-        transient = "remote_compile" in str(e) or "INTERNAL" in str(e)
+        msg = str(e)
+        transient = any(
+            tag in msg
+            for tag in ("remote_compile", "INTERNAL", "UNAVAILABLE",
+                        "DEADLINE_EXCEEDED", "connection")
+        )
         if not transient:
             raise
         log(f"bench attempt 1 failed ({e!r}); retrying once")

@@ -47,11 +47,17 @@ def _ring_attention_local(
     axis_name: str,
     n_blocks: int,
     causal: bool,
+    sub_block: int = 512,
 ) -> jnp.ndarray:
     """Per-device body under shard_map.
 
     q, k, v: [B, Tc, H, hd] local sequence chunks; kv_mask: [B, Tc] with
     1 = real token. Returns [B, Tc, H, hd].
+
+    Each ring hop streams its KV chunk through `sub_block`-sized pieces
+    with the same online-softmax update, so per-device score memory is
+    O(Tc * sub_block) — not O(Tc^2) — and very long shards (32k+ over a
+    small sp) stay inside HBM headroom.
     """
     B, Tc, H, hd = q.shape
     my_idx = jax.lax.axis_index(axis_name)
@@ -63,28 +69,58 @@ def _ring_attention_local(
     # every device has seen every block
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
 
-    def accumulate(k_blk, v_blk, mask_blk, blk_idx, m_run, l_run, acc):
-        """Online-softmax update of (m, l, acc) with one KV block."""
-        # scores for this block: MXU matmul in input dtype, f32 softmax math
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
-        bias = jnp.where(mask_blk[:, None, None, :] > 0, 0.0, NEG_INF)
-        if causal:
-            kv_pos = blk_idx * Tc + jnp.arange(Tc)
-            bias = bias + jnp.where(
-                q_pos[:, None] >= kv_pos[None, :], 0.0, NEG_INF
-            )[None, None, :, :]
-        s = s + bias
+    # sub-blocking of each hop's KV chunk (blockwise flash within the hop);
+    # round down to a power of two first so a non-pow2 sub_block (e.g.
+    # 1536) lands on 1024 against a pow2 shard instead of collapsing to 1
+    sub = min(sub_block, Tc)
+    sub = 1 << (sub.bit_length() - 1)
+    while Tc % sub != 0:  # odd Tc degrades gracefully (sub=1 divides)
+        sub //= 2
+    n_sub = Tc // sub
 
-        m_new = jnp.maximum(m_run, s.max(-1))
-        # m_new is always finite (scores bounded below by NEG_INF), so this
-        # is 0 on the -inf init and a plain rescale afterwards
-        alpha = jnp.exp(m_run - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = alpha * l_run + p.sum(-1)
-        acc_new = alpha[..., None] * acc + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
-        ).astype(jnp.float32)
-        return m_new, l_new, acc_new
+    def accumulate(k_blk, v_blk, mask_blk, blk_idx, m_run, l_run, acc):
+        """Online-softmax update of (m, l, acc) with one hop's KV chunk,
+        streamed in `sub`-wide pieces."""
+
+        def sub_step(carry, xs):
+            m_run, l_run, acc = carry
+            k_s, v_s, mask_s, offsets = xs  # [B?, sub, ...] pieces
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k_s).astype(
+                jnp.float32
+            ) * scale
+            bias = jnp.where(mask_s[:, None, None, :] > 0, 0.0, NEG_INF)
+            if causal:
+                kv_pos = blk_idx * Tc + offsets
+                bias = bias + jnp.where(
+                    q_pos[:, None] >= kv_pos[None, :], 0.0, NEG_INF
+                )[None, None, :, :]
+            s = s + bias
+
+            m_new = jnp.maximum(m_run, s.max(-1))
+            # m_new is always finite (scores bounded below by NEG_INF), so
+            # this is 0 on the -inf init and a plain rescale afterwards
+            alpha = jnp.exp(m_run - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = alpha * l_run + p.sum(-1)
+            acc_new = alpha[..., None] * acc + jnp.einsum(
+                "bhqk,bkhd->bhqd", p.astype(v_s.dtype), v_s
+            ).astype(jnp.float32)
+            return (m_new, l_new, acc_new), None
+
+        if n_sub == 1:
+            (m, l, acc), _ = sub_step(
+                (m_run, l_run, acc),
+                (k_blk, v_blk, mask_blk, jnp.arange(Tc)),
+            )
+            return m, l, acc
+        k_sub = k_blk.reshape(B, n_sub, sub, H, hd).swapaxes(0, 1)
+        v_sub = v_blk.reshape(B, n_sub, sub, H, hd).swapaxes(0, 1)
+        mask_sub = mask_blk.reshape(B, n_sub, sub).swapaxes(0, 1)
+        offsets = jnp.arange(Tc).reshape(n_sub, sub)
+        (m, l, acc), _ = jax.lax.scan(
+            sub_step, (m_run, l_run, acc), (k_sub, v_sub, mask_sub, offsets)
+        )
+        return m, l, acc
 
     # initial accumulators derived from q (not jnp.zeros) so they carry q's
     # varying-mesh-axes type — scan carries must keep a consistent vma type
@@ -126,12 +162,14 @@ def ring_attention(
     *,
     axis: str = "sp",
     causal: bool = True,
+    sub_block: int = 512,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over `mesh` axis ``axis``.
 
     q, k, v: [B, T, H, hd] with T divisible by mesh.shape[axis];
     kv_mask: [B, T] (1 = real token). Batch is treated as sharded over
-    (dp, fsdp), heads over tp, sequence over `axis`.
+    (dp, fsdp), heads over tp, sequence over `axis`. `sub_block` bounds
+    per-device score memory to O(T/sp * sub_block).
     """
     n = mesh.shape[axis]
     if q.shape[1] % n != 0:
@@ -147,7 +185,8 @@ def ring_attention(
     qkv_spec = P(batch_ax, axis, head_ax, None)
     mask_spec = P(batch_ax, axis)
     local = functools.partial(
-        _ring_attention_local, axis_name=axis, n_blocks=n, causal=causal
+        _ring_attention_local, axis_name=axis, n_blocks=n, causal=causal,
+        sub_block=sub_block,
     )
     return shard_map(
         local,
