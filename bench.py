@@ -25,7 +25,6 @@ against the previous round's BENCH_r*.json value when present, else 1.0.
 import glob
 import json
 import os
-import re
 import sys
 import time
 

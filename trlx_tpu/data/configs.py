@@ -7,7 +7,7 @@ mesh axis sizes, dtypes, and from-config model architecture specs (used when
 no pretrained checkpoint is reachable).
 """
 
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import yaml

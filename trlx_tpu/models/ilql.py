@@ -15,7 +15,7 @@ Parity target: reference `CausalLMWithValueHeads`
 
 import functools
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

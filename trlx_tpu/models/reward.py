@@ -16,7 +16,7 @@ that expect the reference contract work unchanged.
 """
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ Architecture variants (selected by ModelSpec.arch):
 """
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax

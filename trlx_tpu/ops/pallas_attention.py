@@ -25,7 +25,6 @@ The public entry `flash_attention` pads T to a block multiple, reshapes
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

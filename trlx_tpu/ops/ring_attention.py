@@ -24,7 +24,6 @@ heads over tp; only the sequence dim rides sp.
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ Registered under both "JaxPPOTrainer" and the reference's name
 "AcceleratePPOModel" so reference YAMLs resolve.
 """
 
-import functools
+
 from typing import Callable, Dict, Optional
 
 import jax

@@ -10,7 +10,7 @@ optax-native here.
 import os
 import time
 from collections import deque
-from typing import Any, Dict, Iterable, List
+from typing import Any, Iterable, List
 
 import jax
 import jax.numpy as jnp
