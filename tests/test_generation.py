@@ -165,3 +165,35 @@ def test_warp_order_matches_hf():
     logits = jnp.array([[0.1, 0.5, 0.4, 0.2, 0.05]])
     out = warp_logits(logits, p)
     assert np.isfinite(np.asarray(out)).any()
+
+
+@pytest.mark.parametrize("arch", ["gpt2", "llama"])
+def test_fori_decode_path_matches_unrolled(arch, monkeypatch):
+    """Deep models (> _UNROLL_MAX_LAYERS) decode through a fori_loop with
+    the stacked cache carried whole; its outputs must bit-match the
+    unrolled per-layer-carry path that shallow models take."""
+    import trlx_tpu.models.generation as gen_mod
+
+    spec, policy, params, blocks, embed, ln_f = setup(arch)
+    B, P = 2, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (B, P), 1, 97)
+    mask = jnp.ones((B, P), jnp.int32)
+    cfg = GenerationConfig(
+        gen_size=6, sampling=SamplingParams(do_sample=True), eos_token_id=7,
+        pad_token_id=0,
+    )
+
+    def run():
+        fn = jax.jit(
+            lambda blocks, embed, ln_f, p, m, rng: generate(
+                spec, blocks, embed, ln_f, p, m, rng, cfg,
+                compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+            )
+        )
+        return fn(blocks, embed, ln_f, prompt, mask, jax.random.PRNGKey(9))
+
+    unrolled = run()
+    monkeypatch.setattr(gen_mod, "_UNROLL_MAX_LAYERS", 0)
+    fori = run()
+    for a, b in zip(unrolled, fori):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -451,9 +451,13 @@ def apply_blocks_with_cache(
 
     h: [B, T, D] fresh suffix; cache: ([L, B, S, H, hd], ...) full buffers;
     mask_bias: [B, 1, T, S] against the buffer; cache_offset: scalar buffer
-    index where the fresh suffix starts. (Unrolling the layer scan was
-    measured on v5e and does not improve decode latency — XLA pipelines
-    the scan body already.)
+    index where the fresh suffix starts.
+
+    NOTE: suitable for PREFILL (one call per sequence). The decode loop does
+    NOT use this: a stacked cache flowing through scan xs/ys re-materializes
+    every step (~4x the cache size in HBM traffic per token, measured on
+    v5e); trlx_tpu.models.generation keeps the cache in the decode scan's
+    carry (per-layer leaves / fori_loop) for in-place updates instead.
     """
     flags = ArchFlags.for_spec(spec)
 
