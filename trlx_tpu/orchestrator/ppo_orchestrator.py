@@ -3,14 +3,22 @@
 Parity target: reference trlx/orchestrator/ppo_orchestrator.py:19-120.
 TPU-first differences:
 
-- Generation, scoring (policy + frozen-ref logprobs + values), and
-  KL-penalty reward shaping all happen in TWO jitted device programs per
-  chunk (generate; score) instead of the reference's generate + two forward
-  passes (one possibly on CPU) + host reward math (reference
+- Prompt selection, generation, scoring (policy + frozen-ref logprobs +
+  values), and KL-penalty reward shaping all happen in ONE jitted device
+  program per chunk (`trainer.rollout`) instead of the reference's generate
+  + two forward passes (one possibly on CPU) + host reward math (reference
   ppo_orchestrator.py:64-98). The user `reward_fn(List[str]) -> scores`
   stays a host callback (contract: reference examples/ppo_sentiments.py:20-28).
-- Host scoring overlaps device work: generation for the next chunk is
-  dispatched (JAX async) before the host decodes/ scores the current one.
+- The host<->device boundary is crossed exactly twice per chunk: ONE fetch
+  of (sequences, seq_kl) — all the host reward callback needs — and the
+  tiny per-row scores array riding the `finalize_rewards` dispatch back.
+  Per-token logprobs/values/rewards stay device-resident end-to-end (each
+  sync on a tunneled/remote TPU costs ~100 ms regardless of payload).
+- The prompt dataset is uploaded to the device once; per chunk the host
+  sends only a [chunk_size] index array (same shuffled-without-replacement
+  iteration order as the host loader it replaces).
+- Host scoring overlaps device work: the rollout for the next chunk is
+  dispatched (JAX async) before the host decodes/scores the current one.
 - The KL controller updates from the measured per-chunk mean KL.
 """
 
@@ -21,6 +29,7 @@ import numpy as np
 
 from trlx_tpu.data.ppo_types import PPORLBatch
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.pipeline import batch_iterator
 from trlx_tpu.utils import Clock
 
 
@@ -38,32 +47,44 @@ class PPOOrchestrator(Orchestrator):
         self.chunk_size = chunk_size
         self.reward_fn = reward_fn
         self.metric_fn = metric_fn
-        self._loader = None
+        self._idx_loader = None
         self._loader_seed = 0
+        self._bank = None  # device-resident (tokens, masks) prompt bank
 
         # circular binding, as in the reference (ppo_orchestrator.py:41-43)
         self.rl_model.set_orchestrator(self, reward_fn)
         self.clock = Clock()
 
-    def _next_prompts(self):
+    def _prompt_bank(self):
+        """The full tokenized prompt set, uploaded to device once."""
+        if self._bank is None:
+            self._bank = self.rl_model._put(
+                (np.asarray(self.pipeline.tokens, np.int32),
+                 np.asarray(self.pipeline.masks, np.int32))
+            )
+        return self._bank
+
+    def _next_idx(self) -> np.ndarray:
+        """Next chunk of prompt indices — identical shuffled-without-
+        replacement iteration to the host loader it replaces
+        (pipeline.create_loader -> batch_iterator)."""
         if len(self.pipeline) < self.chunk_size:
             raise ValueError(
                 f"prompt pipeline has {len(self.pipeline)} prompts but "
                 f"chunk_size is {self.chunk_size}; provide at least "
                 f"chunk_size prompts (or lower chunk_size)"
             )
-        if self._loader is None:
-            self._loader = iter(
-                self.pipeline.create_loader(
-                    self.chunk_size, shuffle=True, seed=self._loader_seed
-                )
+        if self._idx_loader is None:
+            self._idx_loader = batch_iterator(
+                len(self.pipeline), self.chunk_size, True,
+                self._loader_seed, lambda idx: idx,
             )
         try:
-            return next(self._loader)
+            return next(self._idx_loader)
         except StopIteration:
             self._loader_seed += 1
-            self._loader = None
-            return self._next_prompts()
+            self._idx_loader = None
+            return self._next_idx()
 
     def score(self, texts) -> np.ndarray:
         """User reward callback on decoded query+response texts
@@ -75,59 +96,47 @@ class PPOOrchestrator(Orchestrator):
         rollouts (parity: reference ppo_orchestrator.py:51-120)."""
         trainer = self.rl_model
         n_chunks = max(num_rollouts // self.chunk_size, 1)
+        bank_tokens, bank_mask = self._prompt_bank()
 
-        # dispatch generation for chunk 0; inside the loop, dispatch chunk
-        # i+1 before host-scoring chunk i so the device stays busy while the
-        # host runs reward_fn.
-        query, qmask = self._next_prompts()
-        pending = (query, qmask, trainer.generate(query, qmask))
+        # dispatch the fused rollout for chunk 0; inside the loop, dispatch
+        # chunk i+1 before host-scoring chunk i so the device stays busy
+        # while the host runs reward_fn.
+        pending = trainer.rollout(bank_tokens, bank_mask, self._next_idx())
 
         all_kls = []
         all_scores = []
         for i in range(n_chunks):
-            query, qmask, gen = pending
+            out, query, qmask, logprobs, values, kl_rewards, seq_kl = pending
 
-            # dispatch device scoring on the device-resident generation
-            # outputs — it does not need the (host) task scores, which are
-            # added to the last real token below. Dispatched BEFORE the
-            # next chunk's generate so the in-order device stream completes
-            # score(i) first and host reward_fn overlaps generate(i+1).
-            scored = trainer.score_experience(
-                gen.sequences, gen.attention_mask, gen.gen_mask
-            )
             # a mesh-resident learned reward model scores the raw token
             # sequences on device — zero extra transfers (the scores ride
             # the same batched fetch below); host reward_fns get decoded
             # texts, the reference contract
             device_reward = getattr(self.reward_fn, "is_device_reward", False)
             if device_reward:
-                # the RM must see the TRUE response validity: gen.attention
+                # the RM must see the TRUE response validity: out.attention
                 # _mask keeps post-eos pads at 1 (cache-slot validity), so
                 # splice in gen_mask — otherwise early-terminating rows are
                 # summarized at a trailing pad token
                 P = query.shape[1]
                 rm_mask = jax.numpy.concatenate(
-                    [gen.attention_mask[:, :P], gen.gen_mask], axis=1
+                    [out.attention_mask[:, :P], out.gen_mask], axis=1
                 )
-                scores_dev = self.reward_fn.score_tokens(gen.sequences,
+                scores_dev = self.reward_fn.score_tokens(out.sequences,
                                                          rm_mask)
             else:
                 scores_dev = ()
             if i + 1 < n_chunks:
-                q2, m2 = self._next_prompts()
-                pending = (q2, m2, trainer.generate(q2, m2))
+                pending = trainer.rollout(
+                    bank_tokens, bank_mask, self._next_idx()
+                )
 
-            # ONE batched device->host fetch per chunk: per-array pulls
-            # each pay a full host<->device round trip (dominant on
-            # tunneled/remote device topologies). Nested structure, so the
-            # unpacking can't silently shift if score_experience grows.
-            gen_host, scored_host, scores_host = jax.device_get(
-                ((gen.sequences, gen.gen_mask, gen.gen_tokens),
-                 tuple(scored), scores_dev)
+            # THE one device->host fetch per chunk: only what the host
+            # reward callback and the KL controller need. Everything
+            # per-token stays on device.
+            sequences, seq_kl_host, scores_host = jax.device_get(
+                (out.sequences, seq_kl, scores_dev)
             )
-            sequences, gen_mask, gen_tokens = gen_host
-            logprobs, values, kl_rewards, seq_kl = scored_host
-            gen_mask = gen_mask.astype(np.int32)
 
             if device_reward:
                 scores = np.asarray(scores_host, np.float32)
@@ -139,22 +148,21 @@ class PPOOrchestrator(Orchestrator):
             all_scores.append(scores)
 
             # score lands on each row's last REAL response token (parity:
-            # reference ppo_orchestrator.py:92 via kl_penalty_rewards'
-            # masked-last-token rule)
-            rewards = np.array(kl_rewards)
-            last = np.maximum(gen_mask.sum(axis=-1) - 1, 0)
-            rewards[np.arange(rewards.shape[0]), last] += scores
-            mean_kl = float(seq_kl.mean())
+            # reference ppo_orchestrator.py:92), computed ON DEVICE — the
+            # tiny scores array rides the dispatch
+            rewards = trainer.finalize_rewards(kl_rewards, out.gen_mask,
+                                               scores)
+            mean_kl = float(seq_kl_host.mean())
             all_kls.append(mean_kl)
 
             batch = PPORLBatch(
-                query_tensors=np.asarray(query, np.int32),
-                response_tensors=gen_tokens.astype(np.int32),
+                query_tensors=query,
+                response_tensors=out.gen_tokens,
                 logprobs=logprobs,
                 values=values,
                 rewards=rewards,
-                response_masks=gen_mask,
-                query_masks=np.asarray(qmask, np.int32),
+                response_masks=out.gen_mask,
+                query_masks=qmask,
             )
             trainer.push_to_store(batch)
             self.clock.tick(len(sequences))

@@ -111,9 +111,17 @@ class PPORolloutStorage(BaseRolloutStore):
             return None
         if len(self.history) == 1:
             return self.history[0]
-        return jax.tree_util.tree_map(
-            lambda *xs: np.concatenate(xs, axis=0), *self.history
-        )
+
+        def cat(*xs):
+            # device-resident chunks stay on device (np.concatenate would
+            # silently pull every chunk through the host)
+            if any(isinstance(x, jax.Array) for x in xs):
+                import jax.numpy as jnp
+
+                return jnp.concatenate(xs, axis=0)
+            return np.concatenate(xs, axis=0)
+
+        return jax.tree_util.tree_map(cat, *self.history)
 
     def __getitem__(self, index: int):
         return self._stacked().unstack()[index]

@@ -7,10 +7,11 @@ trlx/model/accelerate_base_model.py:26-185). TPU-first differences:
 - One jitted `train_step` does GAE (lax.scan) + advantage whitening + the
   forward + clipped losses + optax update; the reference runs a Python GAE
   loop and separate backward/step calls (accelerate_ppo_model.py:68-82,196-203).
-- One jitted `score_experience` computes policy logprobs, frozen-ref
+- One jitted rollout program (`rollout`) selects prompts from the
+  device-resident bank, generates, and scores — policy logprobs, frozen-ref
   logprobs, values, and per-token KL-penalty rewards in a single forward
-  that shares the trunk — the reference runs the trained model AND a second
-  hydra/CPU-copy pass (ppo_orchestrator.py:71-77).
+  that shares the trunk. The reference runs generate + the trained model
+  AND a second hydra/CPU-copy pass (ppo_orchestrator.py:64-98).
 - Gradient clipping and weight decay from the config are actually applied
   (the reference configures but never applies them — SURVEY quirks).
 - Distribution comes from the mesh (trlx_tpu.parallel), not an Accelerator.
@@ -191,6 +192,35 @@ class JaxPPOTrainer(BaseRLTrainer):
             )
             return logprobs, vals, rewards, seq_kl
 
+        def rollout_fn(params, bank_tokens, bank_mask, idx, rng, kl_coef):
+            """One fused device program per rollout chunk: prompt selection
+            (device-resident bank, host sends only [chunk] indices) ->
+            generation -> shared-trunk scoring -> KL-penalty rewards.
+
+            Host<->device syncs on a tunneled/remote TPU cost ~100 ms each
+            regardless of payload, so the rollout keeps everything on device
+            and the orchestrator fetches only (sequences, seq_kl) — the two
+            things the host reward callback actually needs."""
+            query = bank_tokens[idx]
+            query_mask = bank_mask[idx]
+            out = generate_fn(params, query, query_mask, rng)
+            logprobs, vals, kl_rewards, seq_kl = score_fn(
+                params, out.sequences, out.attention_mask, out.gen_mask,
+                kl_coef, query.shape[1],
+            )
+            return out, query, query_mask, logprobs, vals, kl_rewards, seq_kl
+
+        def finalize_rewards(kl_rewards, gen_mask, scores):
+            """Add the host task score to each row's last real response token
+            (parity: reference ppo_orchestrator.py:92). Runs on device so the
+            rollout's per-token tensors never round-trip through the host;
+            `scores` arrives as a tiny per-row host array riding the
+            dispatch."""
+            last = jnp.maximum(gen_mask.sum(axis=-1) - 1, 0)
+            return kl_rewards.at[
+                jnp.arange(kl_rewards.shape[0]), last
+            ].add(scores)
+
         def train_step(params, opt_state, batch: PPORLBatch):
             query = batch.query_tensors
             response = batch.response_tensors
@@ -239,9 +269,30 @@ class JaxPPOTrainer(BaseRLTrainer):
             stats["grad_norm"] = optax.global_norm(grads)
             return params, opt_state, stats
 
+        def train_multi(params, opt_state, batch: PPORLBatch):
+            """`ppo_epochs` optimization passes over one minibatch in a
+            single dispatch (the reference's inner loop,
+            accelerate_ppo_model.py:196-203, as a lax.scan). Returns the
+            LAST pass's stats, matching what the per-step loop logged."""
+
+            def one(carry, _):
+                params, opt_state = carry
+                params, opt_state, stats = train_step(
+                    params, opt_state, batch
+                )
+                return (params, opt_state), stats
+
+            (params, opt_state), stats_seq = jax.lax.scan(
+                one, (params, opt_state), None, length=m.ppo_epochs
+            )
+            last_stats = jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
+            return params, opt_state, last_stats
+
         self._generate_fn = jax.jit(generate_fn)
-        self._score_fn = jax.jit(score_fn, static_argnames="input_size")
+        self._rollout_fn = jax.jit(rollout_fn, static_argnames=())
+        self._finalize_rewards = jax.jit(finalize_rewards)
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._train_multi = jax.jit(train_multi, donate_argnums=(0, 1))
 
     # -- BaseRLTrainer surface ------------------------------------------ #
 
@@ -282,29 +333,22 @@ class JaxPPOTrainer(BaseRLTrainer):
         )
         return self.tokenizer.batch_decode(np.asarray(out.sequences))
 
-    def score_experience(self, sequences, attention_mask, response_mask):
-        """Dispatch device scoring; returns DEVICE arrays
-        (logprobs, values, kl_rewards, seq_kl) — no host sync.
-
-        kl_rewards carry only the per-token KL penalty; the caller adds the
-        task score to each row's last real token after reward_fn runs (the
-        orchestrator batches that into its single per-chunk device_get).
-        Inputs already on device (the generation outputs) are used in
-        place; host arrays are uploaded in one transfer."""
-        host, dev = {}, {}
-        for name, x in (("seqs", sequences), ("attn", attention_mask),
-                        ("rmask", response_mask)):
-            if isinstance(x, jax.Array):
-                dev[name] = x
-            else:
-                host[name] = np.asarray(x)
-        if host:
-            host = dict(zip(host.keys(), self._put(tuple(host.values()))))
-        put = {**dev, **host}
-        return self._score_fn(
-            self.params, put["seqs"], put["attn"], put["rmask"],
+    def rollout(self, bank_tokens, bank_mask, idx):
+        """Dispatch one fused rollout chunk (select prompts by `idx` from the
+        device-resident bank, generate, score). Returns DEVICE arrays
+        (out, query, query_mask, logprobs, values, kl_rewards, seq_kl) — no
+        host sync; the orchestrator batches the one fetch it needs."""
+        idx = jnp.asarray(np.asarray(idx, np.int32))
+        return self._rollout_fn(
+            self.params, bank_tokens, bank_mask, idx, self.next_rng(),
             jnp.float32(self.kl_ctl.value),
-            self.config.train.input_size,
+        )
+
+    def finalize_rewards(self, kl_rewards, gen_mask, scores):
+        """Device-side rewards = kl_rewards + task score at the last real
+        token; `scores` is a small host array riding the dispatch."""
+        return self._finalize_rewards(
+            kl_rewards, gen_mask, np.asarray(scores, np.float32)
         )
 
     def get_components(self) -> Dict:
@@ -393,13 +437,14 @@ class JaxPPOTrainer(BaseRLTrainer):
             )
             for batch in loader:
                 batch = self._put(batch)
-                stats = None
                 with annotate("ppo_update"):
-                    for _ in range(m.ppo_epochs):
-                        self.params, self.opt_state, stats = self._train_step(
-                            self.params, self.opt_state, batch
-                        )
-                        self.iter_count += 1
+                    # all ppo_epochs passes in ONE dispatch — per-dispatch
+                    # latency on tunneled devices makes N separate train
+                    # steps measurably slower than one scanned program
+                    self.params, self.opt_state, stats = self._train_multi(
+                        self.params, self.opt_state, batch
+                    )
+                    self.iter_count += m.ppo_epochs
                 clock.tick(len(batch.query_tensors) * m.ppo_epochs)
 
                 intervals = self.intervals(self.iter_count)
