@@ -118,7 +118,15 @@ def _pad_t(x, multiple, axis, value=0):
 
 def _flash_forward(q, k, v, kv_mask, block_q, block_k, causal):
     """Padded + flattened pallas_call. q/k/v: [B, T, H, hd]; mask: [B, T].
-    Returns (out [B, T, H, hd], lse [B, H, Tp])."""
+    Returns (out [B, T, H, hd], lse [B, H, Tp]).
+
+    Practical T ceiling: each grid program stages the FULL-length K and V
+    rows ([1, Tp, hd]) in VMEM (plus q/out blocks), so usable Tp tops out
+    around ~32k at hd=128 in bf16 against the ~16 MB/core VMEM budget —
+    the kernel targets the single-chip 1k-32k regime. Beyond that, shard
+    the sequence instead: the ring-attention sp path
+    (trlx_tpu.ops.ring_attention) keeps per-device length T/sp and is the
+    designed long-context mechanism."""
     B, T, H, hd = q.shape
     Tp = T + ((-T) % max(block_q, block_k))
     if Tp % block_q != 0 or Tp % block_k != 0:
@@ -467,7 +475,8 @@ _MIN_FUSED_T = 128
 
 
 def make_pallas_attention_fn(
-    block: int = 128, causal: bool = True, mesh=None
+    block: int = 128, causal: bool = True, mesh=None,
+    min_fused_t: int = None,
 ):
     """An `attention_fn` for the transformer trunk running the fused Pallas
     kernel. Takes the raw [B, T] mask (`takes_raw_mask = True`) like the
@@ -475,7 +484,9 @@ def make_pallas_attention_fn(
 
     Per-call adaptivity (the actual batch length can differ from the config
     — ILQL pads to each batch's own max): sequences shorter than
-    `_MIN_FUSED_T` fall back to dense XLA attention. With a `mesh`, the
+    `min_fused_t` (default `_MIN_FUSED_T`; trainers pass their measured
+    parity point when the kernel is auto- rather than force-enabled) fall
+    back to dense XLA attention. With a `mesh`, the
     kernel runs under shard_map (batch over (dp, fsdp), heads over tp) —
     a bare Mosaic custom call has no GSPMD partitioning rule, so without
     the wrapper a multichip jit would gather the global batch per chip."""
@@ -487,8 +498,10 @@ def make_pallas_attention_fn(
         from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
+    min_t = _MIN_FUSED_T if min_fused_t is None else min_fused_t
+
     def pallas_attention(q, k, v, attention_mask):
-        if q.shape[1] < _MIN_FUSED_T:
+        if q.shape[1] < min_t:
             if causal:
                 bias = causal_mask_bias(attention_mask)
             else:  # padding-only: every (real) key visible to every query

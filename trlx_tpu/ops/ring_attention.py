@@ -177,10 +177,28 @@ def ring_attention(
         )
     # shard batch/head dims only where the mesh axis divides them — a dim
     # that doesn't divide is computed replicated, which is correct, just
-    # less parallel (tiny test shapes; real workloads divide)
+    # less parallel (tiny test shapes; real workloads divide). Warn loudly:
+    # in a production sharded jit a non-divisible batch would all-gather
+    # the GLOBAL batch per layer.
     n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
     batch_ax = ("dp", "fsdp") if q.shape[0] % n_data == 0 else None
     head_ax = "tp" if q.shape[2] % mesh.shape["tp"] == 0 else None
+    bad = []
+    if batch_ax is None:
+        bad.append(f"batch {q.shape[0]} vs dp*fsdp={n_data}")
+    if head_ax is None:
+        bad.append(f"heads {q.shape[2]} vs tp={mesh.shape['tp']}")
+    if bad:
+        import warnings
+
+        warnings.warn(
+            f"ring_attention: {'; '.join(bad)} — dimension(s) do not "
+            f"divide their mesh axes; computing them REPLICATED on every "
+            f"device (correct but unsharded — each device gathers the "
+            f"global dimension per layer). Pad to a multiple of the mesh "
+            f"extent for real workloads.",
+            stacklevel=2,
+        )
     qkv_spec = P(batch_ax, axis, head_ax, None)
     mask_spec = P(batch_ax, axis)
     local = functools.partial(

@@ -39,13 +39,12 @@ class BaseRLTrainer:
         self.config = config
         self.train_mode = train_mode
         self.store = None
-        # set BOTH ways: the flag is process-global, and a True from an
-        # earlier trainer must not leak into later ones
-        import jax
+        # opt-in only: an unset config flag must not clobber a debug flag
+        # the user enabled externally (JAX_DEBUG_NANS / jax.config)
+        if getattr(config.train, "debug_nans", False):
+            import jax
 
-        jax.config.update(
-            "jax_debug_nans", bool(getattr(config.train, "debug_nans", False))
-        )
+            jax.config.update("jax_debug_nans", True)
         # multi-host bootstrap first (no-op single-process), so the mesh
         # sees the pod's global device list
         initialize_runtime()
@@ -136,7 +135,17 @@ class BaseRLTrainer:
         if fused:
             from trlx_tpu.ops.pallas_attention import make_pallas_attention_fn
 
-            return make_pallas_attention_fn(mesh=self.mesh)
+            # gate per-call on the ACTUAL traced length, not just the config
+            # length: ILQL pads each batch to its own max, so auto-enabled
+            # runs can still see short batches below the kernel's measured
+            # parity point — those take the dense fallback inside the fn.
+            # An explicit model.fused_attention=True keeps the kernel's own
+            # lower floor (the user asked for the kernel).
+            forced = self.config.model.fused_attention is not None
+            return make_pallas_attention_fn(
+                mesh=self.mesh,
+                min_fused_t=None if forced else self.FUSED_ATTENTION_MIN_T,
+            )
         return None
 
     def push_to_store(self, data) -> None:
