@@ -344,3 +344,35 @@ def test_ppo_e2e_llama_arch_on_mesh(devices):
     trainer.learn(log_fn=logs.append)
     train_logs = [l for l in logs if "loss" in l]
     assert train_logs and np.isfinite(train_logs[-1]["loss"])
+
+
+def test_broadcast_host_floats_single_process_identity():
+    from trlx_tpu.parallel import broadcast_host_floats
+
+    vals = [0.25, -1.5, 3.0]
+    out = broadcast_host_floats(vals)
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, np.asarray(vals, np.float32))
+
+
+def test_broadcast_host_floats_uses_process0_when_multihost(monkeypatch):
+    """Multi-process: every host must get process-0's array via
+    multihost_utils.broadcast_one_to_all (divergent host reward floats
+    would otherwise fork the SPMD replicas)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from trlx_tpu.parallel import broadcast_host_floats
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    called = {}
+
+    def fake_broadcast(arr):
+        called["arr"] = np.asarray(arr)
+        return np.asarray(arr) + 0  # process-0's view
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                        fake_broadcast)
+    out = broadcast_host_floats([1.0, 2.0])
+    np.testing.assert_array_equal(called["arr"], [1.0, 2.0])
+    np.testing.assert_array_equal(out, [1.0, 2.0])
+    assert out.dtype == np.float32

@@ -37,7 +37,12 @@ class OfflineOrchestrator(Orchestrator):
             m[-1] = 0
             attention_mask.append(m)
 
-        returns = np.asarray(reward_fn(train_samples), np.float32)
+        # process-0 broadcast: host reward_fn outputs are not guaranteed
+        # bit-identical across hosts, and these returns feed sharded device
+        # batches on every host (replicated-loading SPMD)
+        from trlx_tpu.parallel import broadcast_host_floats
+
+        returns = broadcast_host_floats(reward_fn(train_samples))
         returns = (returns - returns.mean()) / (returns.std() + 1e-30)
 
         rewards = []

@@ -88,8 +88,14 @@ class PPOOrchestrator(Orchestrator):
 
     def score(self, texts) -> np.ndarray:
         """User reward callback on decoded query+response texts
-        (parity: reference ppo_orchestrator.py:45-49)."""
-        return np.asarray(self.reward_fn(texts), dtype=np.float32)
+        (parity: reference ppo_orchestrator.py:45-49), broadcast from
+        process 0: host reward outputs (HF pipelines, service calls) are
+        not guaranteed bit-identical across hosts, and they feed sharded
+        device rewards — divergent floats would silently fork the SPMD
+        replicas."""
+        from trlx_tpu.parallel import broadcast_host_floats
+
+        return broadcast_host_floats(self.reward_fn(texts))
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Fill the trainer's rollout store with `num_rollouts` scored

@@ -43,6 +43,7 @@ from trlx_tpu.parallel.sharding import (  # noqa: F401
     sharded_opt_init,
 )
 from trlx_tpu.parallel.runtime import (  # noqa: F401
+    broadcast_host_floats,
     initialize_runtime,
     is_main_process,
     process_count,

@@ -60,3 +60,29 @@ def is_main_process() -> bool:
     """Metrics/checkpoint emission gate (parity: the reference's
     `accelerator.is_main_process`, trlx/model/accelerate_base_model.py:58)."""
     return jax.process_index() == 0
+
+
+def broadcast_host_floats(values) -> "np.ndarray":
+    """Process-0's view of a host-computed float array, identical on every
+    process. No-op single-process.
+
+    Replicated-loading SPMD (trlx_tpu.parallel.sharding.shard_batch)
+    requires every host to feed bit-identical global batches. Prompts are
+    seed-deterministic, but host `reward_fn` outputs (an HF pipeline, a
+    service call) are NOT guaranteed bit-identical across hosts — and
+    rewards feed device_put shards, so divergent floats would silently fork
+    the replicas. Broadcasting from process 0 closes that hole, replacing
+    the reference's per-rank loader split + gather
+    (reference: trlx/orchestrator/ppo_orchestrator.py:32-35,
+    trlx/model/accelerate_ilql_model.py:124).
+    """
+    import numpy as np
+
+    arr = np.asarray(values, np.float32)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(arr), np.float32
+    )
