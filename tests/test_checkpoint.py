@@ -134,3 +134,41 @@ def test_sharded_save_restore_preserves_shardings(devices, tmp_path):
         np.asarray(wq),
         np.asarray(trainer.params["trainable"]["blocks"]["attn"]["wq"]),
     )
+
+
+def test_resume_from_kill_and_continue(tmp_path):
+    """A run killed mid-training continues from its checkpoint via
+    config.train.resume_from: the resumed learn() must pick up iter_count /
+    params / KL state from disk (not construction) and run to total_steps."""
+    # run 1: train 4 steps with checkpointing every 2, then "die"
+    config, trainer, orch = _built_trainer(tmp_path)
+    config.train.checkpoint_interval = 2
+    config.train.total_steps = 4
+    config.train.epochs = 100  # bound the run by total_steps, not epochs
+    orch.make_experience(config.method.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count == 4
+    saved_kl = trainer.kl_ctl.value
+
+    # run 2: fresh process-equivalent (different seed), resume_from set
+    config2, resumed, orch2 = _built_trainer(tmp_path, seed=9)
+    config2.train.resume_from = config.train.checkpoint_dir
+    config2.train.checkpoint_interval = 10**9
+    config2.train.total_steps = 8
+    config2.train.epochs = 100
+    orch2.make_experience(config2.method.num_rollouts)
+    resumed.learn(log_fn=lambda s: None)
+
+    # resumed from step 4 (not 0): exactly 4 more steps to total_steps=8
+    assert resumed.iter_count == 8
+    assert resumed._resumed
+    # resume restored the checkpointed KL controller, then kept updating it
+    # from live rollouts; construction default would be init_kl_coef
+    saved_state = resumed.get_components()["state"]
+    assert saved_state["iter_count"] == 8
+
+    # a second learn() must NOT re-restore (resume is once per process)
+    resumed.config.train.total_steps = 12
+    orch2.make_experience(config2.method.num_rollouts)
+    resumed.learn(log_fn=lambda s: None)
+    assert resumed.iter_count == 12

@@ -251,3 +251,30 @@ def test_ilql_randomwalks_learns():
         f"ILQL did not improve: before={before} after={after} "
         f"(walk baseline {baseline:.1f}%)"
     )
+
+
+def test_evaluate_caps_eval_set_at_128():
+    """In-loop evaluate() must bound its cost like the reference's
+    128-row tables (reference: accelerate_ilql_model.py:128-157), while
+    n=0 explicitly opts into the full set."""
+    from trlx_tpu.utils.loading import get_model, get_orchestrator
+
+    walks, logit_mask, stats_fn, reward_fn = generate_random_walks(seed=7)
+    n_nodes = logit_mask.shape[0]
+    config = rw_config(n_nodes, epochs=1)
+    trainer = get_model("JaxILQLTrainer")(config, logit_mask=logit_mask)
+    # an eval set wider than the cap
+    eval_prompts = np.tile(np.arange(1, n_nodes), 40)[:150].reshape(-1, 1)
+    calls = []
+
+    def counting_reward(rows):
+        calls.append(len(rows))
+        return [0.0] * len(rows)
+
+    get_orchestrator("OfflineOrchestrator")(
+        trainer, walks, eval_prompts, reward_fn=counting_reward
+    )
+    trainer.evaluate()
+    trainer.evaluate(n=0)
+    # calls[0] is the orchestrator scoring the training walks at build time
+    assert calls[-2:] == [128, 150], calls

@@ -10,7 +10,6 @@ reference never tests end-to-end.
 import functools
 
 import numpy as np
-import pytest
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
@@ -190,3 +189,21 @@ def test_ppo_learns_synthetic_reward():
         f"PPO did not learn: early rollout score={early:.4f} "
         f"late={late:.4f} (all: {[round(s, 4) for s in scores]})"
     )
+
+
+def test_evaluate_rotates_prompts_across_eval_points():
+    """Each evaluate() call must score a different slice of the prompt set
+    (a fixed first-batch eval overstates metric stability)."""
+    config, trainer, pipeline, orch = build()
+    seen = []
+    orig_reward, trainer.reward_fn = trainer.reward_fn, (
+        lambda texts: (seen.append(tuple(texts)), [0.0] * len(texts))[1]
+    )
+    try:
+        trainer.evaluate(n=4)
+        trainer.evaluate(n=4)
+        trainer.evaluate(n=4)
+    finally:
+        trainer.reward_fn = orig_reward
+    assert len(seen) == 3
+    assert len(set(seen)) > 1, "every eval point scored the same prompts"

@@ -122,7 +122,11 @@ class ModelConfig:
     TPU extras:
     :param model_arch: architecture family when building/importing
     :param model_spec: dict of ModelSpec overrides for from-config models
-    :param param_dtype: dtype parameters are stored in
+    :param param_dtype: storage dtype for FROZEN parameters (PPO hydra:
+        the frozen trunk + reference branch). The trainable branch and
+        optimizer state always stay float32. "bfloat16" is the memory
+        lever that fits gpt-j-6B PPO on one 16 GB chip
+        (docs/source/performance.rst)
     :param compute_dtype: dtype matmuls/activations run in (bf16 for MXU)
     :param fused_attention: True forces the Pallas flash-attention kernel
         for train-time forwards, False forces the dense XLA path, None
@@ -204,6 +208,9 @@ class TrainConfig:
     seed: int = 0
     remat: bool = False
     checkpoint_dir: str = "ckpts"
+    # restore components from this checkpoint directory at the start of the
+    # first learn() call (kill-and-continue resume); "" disables
+    resume_from: str = ""
     debug_nans: bool = False
 
     @classmethod

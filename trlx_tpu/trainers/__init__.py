@@ -148,6 +148,60 @@ class BaseRLTrainer:
             )
         return None
 
+    def _check_memory_fit(self, spec, frozen_dtype) -> None:
+        """Fail BEFORE allocation with an actionable message when the model
+        state clearly cannot fit the per-device HBM budget (a 24 GB fp32
+        gpt-j-6B OOMing mid-init is far harder to diagnose). Estimates
+        params (frozen in frozen_dtype, trainable+ref tops, fp32 adam
+        moments for the trainable top), divided by the mesh's parameter
+        sharding extent (fsdp * tp). Skipped when the runtime exposes no
+        bytes_limit or TRLX_TPU_SKIP_MEMCHECK=1."""
+        import os
+
+        if os.environ.get("TRLX_TPU_SKIP_MEMCHECK"):
+            return
+        import jax
+        import numpy as np
+
+        try:
+            limit = (jax.local_devices()[0].memory_stats() or {}).get(
+                "bytes_limit"
+            )
+        except Exception:
+            limit = None
+        if not limit:
+            return
+        d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+        per_layer = 4 * d * d + 2 * d * f  # qkv/o + mlp (biases negligible)
+        k = self.config.model.num_layers_unfrozen
+        k = L if k < 0 else min(k, L)
+        embed = V * d + spec.n_positions * d
+        # an untied lm_head lives in BOTH the trainable branch (fp32 +
+        # adam) and the ref copy (frozen_dtype) — at 6B scale it is ~2.5 GB
+        # of the trainable budget and must not be omitted
+        lm_head = 0 if spec.tie_lm_head else V * d
+        frozen_sz = np.dtype(frozen_dtype).itemsize
+        est = (
+            ((L - k) * per_layer + embed) * frozen_sz   # frozen trunk
+            + (k * per_layer + lm_head) * frozen_sz     # ref branch
+            + (k * per_layer + lm_head) * 4 * 3         # trainable + 2 adam
+        )
+        shards = 1
+        if self.mesh is not None:
+            shards = self.mesh.shape.get("fsdp", 1) * self.mesh.shape.get(
+                "tp", 1
+            )
+        est //= shards
+        if est > int(limit * 1.05):
+            raise ValueError(
+                f"model state needs ~{est / 2**30:.1f} GB/device but the "
+                f"device reports {limit / 2**30:.1f} GB HBM. Options: set "
+                f"model.param_dtype: bfloat16 (frozen trunk + ref branch "
+                f"storage; trainable/optimizer stay fp32), lower "
+                f"num_layers_unfrozen, shard over a mesh with fsdp/tp, or "
+                f"set TRLX_TPU_SKIP_MEMCHECK=1 to try anyway."
+            )
+
     def push_to_store(self, data) -> None:
         """Append experience to the rollout store
         (parity: reference model/__init__.py:46)."""
@@ -230,6 +284,20 @@ class BaseRLTrainer:
             self.get_components(), directory or self.config.train.checkpoint_dir
         )
         self.set_components(restored)
+
+    def maybe_resume(self) -> bool:
+        """Restore from config.train.resume_from once, at trainer
+        construction — BEFORE any make_experience/evaluate the caller runs,
+        so resumed rollouts come from the restored policy, not the fresh
+        init. The kill-and-continue path the reference's dead checkpointing
+        never had (reference: trlx/model/__init__.py:101-129). Returns True
+        when a restore actually happened."""
+        directory = getattr(self.config.train, "resume_from", "")
+        if not directory or getattr(self, "_resumed", False):
+            return False
+        self.load(directory)
+        self._resumed = True
+        return True
 
     def set_components(self, components: Dict) -> None:
         raise NotImplementedError

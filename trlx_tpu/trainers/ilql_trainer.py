@@ -91,6 +91,9 @@ class JaxILQLTrainer(BaseRLTrainer):
         self.stats_fn: Optional[Callable] = None
 
         self._build_jitted_fns()
+        # resume at construction (see JaxPPOTrainer: restored state must be
+        # live before any evaluation/sampling the caller does pre-learn)
+        self.maybe_resume()
 
     # ------------------------------------------------------------------ #
 
@@ -260,12 +263,22 @@ class JaxILQLTrainer(BaseRLTrainer):
 
     # -- learn loop -------------------------------------------------------- #
 
-    def evaluate(self, n: int = 0):
+    #: in-loop eval cap — the reference samples/tabulates at most 128 eval
+    #: rows per eval point (reference: accelerate_ilql_model.py:128-157);
+    #: scanning an unbounded eval set every eval_interval is the cost bug.
+    EVAL_CAP = 128
+
+    def evaluate(self, n: int = None):
         """Generate from eval prompts with the advantage-shifted sampler and
-        score/stat them (parity: reference accelerate_ilql_model.py:109-157)."""
+        score/stat them (parity: reference accelerate_ilql_model.py:109-157).
+
+        n: row cap; None applies EVAL_CAP, 0 means the full eval set
+        (explicit opt-in for final/offline evaluation)."""
         if self.eval_pipeline is None or len(self.eval_pipeline) == 0:
             return {}
         prompts = self.eval_pipeline.texts
+        if n is None:
+            n = self.EVAL_CAP
         if n:
             prompts = prompts[:n]
         samples = self.sample(prompts)
@@ -294,6 +307,7 @@ class JaxILQLTrainer(BaseRLTrainer):
         of the loop (trlx_tpu.utils.profiling)."""
         from trlx_tpu.utils.profiling import maybe_trace
 
+        self.maybe_resume()  # no-op when already restored at construction
         with maybe_trace():
             self._learn_loop(log_fn, save_fn, eval_fn)
 

@@ -104,10 +104,20 @@ class JaxPPOTrainer(BaseRLTrainer):
             remat=config.train.remat,
             attention_fn=self._train_attention_fn(),
         )
+        # param_dtype applies to the FROZEN trunk + reference branch only;
+        # the trainable branch and its optimizer state stay float32 (the
+        # 6B-on-one-chip memory lever — frozen storage dtype costs nothing
+        # in optimizer quality; see docs/source/performance.rst)
+        frozen_dtype = DTYPES[config.model.param_dtype]
+        self._check_memory_fit(spec, frozen_dtype)
         if trunk is not None:
-            self.params = hydra_params_from_trunk(self.policy, *trunk, head_rng)
+            self.params = hydra_params_from_trunk(
+                self.policy, *trunk, head_rng, frozen_dtype=frozen_dtype
+            )
         else:
-            self.params = self.policy.init(init_rng)
+            self.params = self.policy.init(
+                init_rng, frozen_dtype=frozen_dtype
+            )
 
         # --- optimizer -----------------------------------------------------
         self.opt = build_optimizer(config.train)
@@ -132,6 +142,11 @@ class JaxPPOTrainer(BaseRLTrainer):
         self.reward_fn: Optional[Callable] = None
         self.logit_mask = None  # optional [V] bool; see set_logit_mask
         self._build_jitted_fns()
+        # resume at CONSTRUCTION, not first learn(): the documented flow
+        # runs make_experience() before learn(), and rollouts generated by
+        # un-restored params would poison the first epoch's importance
+        # ratios/advantages with a policy mismatch
+        self.maybe_resume()
 
     # ------------------------------------------------------------------ #
 
@@ -384,7 +399,13 @@ class JaxPPOTrainer(BaseRLTrainer):
         if eval_prompts is None:
             if self.orch is None:
                 return {}
-            loader = self.orch.pipeline.create_loader(n, shuffle=False)
+            # rotate which prompts are scored: a fixed first batch of an
+            # unshuffled loader would overstate metric stability across
+            # eval points
+            self._eval_round = getattr(self, "_eval_round", -1) + 1
+            loader = self.orch.pipeline.create_loader(
+                n, shuffle=True, seed=self._eval_round
+            )
             try:
                 eval_prompts = next(iter(loader))
             except StopIteration:
@@ -426,6 +447,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         clock = Clock()
+        self.maybe_resume()  # no-op when already restored at construction
 
         with maybe_trace():
             self._learn_loop(log_fn, cfg, m, clock, annotate)
