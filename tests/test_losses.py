@@ -224,3 +224,48 @@ def test_ilql_losses_finite_with_out_of_vocab_pad():
     assert np.isfinite(float(loss)), stats
     for k, v in stats.items():
         assert np.isfinite(float(v)), (k, v)
+
+
+def test_adaptive_kl_cadence_regimes_match():
+    """Repo cadence (one update per rollout refresh, n = num_rollouts)
+    must drive the coefficient through the same regime as the reference
+    cadence (one update per optimizer batch, n = batch_size —
+    reference: accelerate_ppo_model.py:106,130-135).
+
+    Both cadences see the same underlying KL trajectory; because the
+    controller's step size is proportional to n/horizon, R updates of
+    batch_size samples move the coefficient like one update of
+    R * batch_size samples to first order. Simulate a realistic
+    trajectory (KL rising above target, then controlled back) and assert
+    the two coefficient paths track within a tight band."""
+    horizon, target = 10000, 6.0
+    batch_size, refreshes, batches_per_refresh = 128, 60, 4
+
+    # KL trajectory: starts low, overshoots to 2x target, decays back —
+    # the shape an adaptive-penalty run actually produces
+    def kl_at(t):
+        rise = min(t / 20.0, 1.0)
+        decay = 1.0 / (1.0 + 0.05 * max(t - 25, 0))
+        return 0.5 + (2 * target - 0.5) * rise * decay
+
+    ref = AdaptiveKLController(0.2, target, horizon)
+    repo = AdaptiveKLController(0.2, target, horizon)
+    ref_path, repo_path = [], []
+    for r in range(refreshes):
+        kl = kl_at(r)
+        # reference: an update after EVERY optimizer batch in the refresh
+        for _ in range(batches_per_refresh):
+            ref.update(kl, batch_size)
+        # repo: ONE update per refresh with the full rollout count
+        repo.update(kl, batches_per_refresh * batch_size)
+        ref_path.append(ref.value)
+        repo_path.append(repo.value)
+
+    ref_path = np.asarray(ref_path)
+    repo_path = np.asarray(repo_path)
+    # same regime: tight multiplicative band the whole run, same endpoint
+    ratio = repo_path / ref_path
+    assert ratio.max() < 1.05 and ratio.min() > 0.95, (
+        ratio.min(), ratio.max())
+    # and the dynamics actually exercised the controller (rose then fell)
+    assert repo_path.max() > 0.21 and repo_path[-1] < repo_path.max()
