@@ -1,0 +1,18 @@
+# Style targets (parity: reference Makefile:1-14, black/isort/flake8 there).
+# ruff covers formatting-adjacent lint + import order; the stdlib fallback
+# (tests/test_style.py) enforces the core rules where ruff isn't installed.
+
+.PHONY: style check test
+
+check:
+	@command -v ruff >/dev/null 2>&1 \
+		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
+		|| python -m pytest tests/test_style.py -q
+
+style:
+	@command -v ruff >/dev/null 2>&1 \
+		&& ruff check --fix trlx_tpu tests examples bench.py __graft_entry__.py \
+		|| python -m pytest tests/test_style.py -q
+
+test:
+	python -m pytest tests/ -x -q

@@ -47,8 +47,6 @@ def build_reward_model(config, trainer):
     scale a second host copy would double peak RAM). With a from-config
     trainer this reuses its random-init trunk; either way the RM gets a
     fresh scalar head (stand-in for a trained RM checkpoint)."""
-    import jax.numpy as jnp
-
     spec = trainer.policy.spec
     model = RewardModel(
         spec=spec,

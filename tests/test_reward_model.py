@@ -8,7 +8,6 @@ import numpy as np
 
 from trlx_tpu.data.configs import ModelSpec
 from trlx_tpu.models.reward import DeviceRewardModel, RewardModel
-from trlx_tpu.parallel import build_mesh
 from trlx_tpu.utils.tokenizer import ByteTokenizer
 
 
@@ -147,7 +146,7 @@ def test_rm_survives_trainer_param_donation(devices):
     """Regression (review-found): an RM built from the trainer's OWN trunk
     must not alias the trainer's buffers — train steps donate params, and
     aliased RM leaves would be deleted after the first update."""
-    from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
+    from tests.test_ppo_e2e import PROMPTS, make_config
     from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
 
     config = make_config(

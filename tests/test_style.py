@@ -1,0 +1,94 @@
+"""Stdlib lint: the core style rules `make check` enforces, runnable with
+plain pytest in environments where ruff cannot be installed (no egress).
+
+Covers the highest-signal subset of the configured ruff rules
+(pyproject.toml [tool.ruff]): files must parse, no unused module-level
+imports (F401, minus `# noqa` re-export shims), no tabs in indentation,
+no trailing whitespace, and no `== None` / `!= None` comparisons (E711).
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TARGETS = sorted(
+    p
+    for root in ("trlx_tpu", "tests", "examples")
+    for p in (REPO / root).rglob("*.py")
+) + [REPO / "bench.py", REPO / "__graft_entry__.py"]
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # __all__ strings count as uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            used.add(el.value)
+    return used
+
+
+@pytest.mark.parametrize("path", TARGETS, ids=lambda p: str(p.relative_to(REPO)))
+def test_lint(path):
+    src = path.read_text()
+    lines = src.splitlines()
+    problems = []
+
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # pragma: no cover
+        pytest.fail(f"{path}: does not parse: {e}")
+
+    used = _used_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if getattr(node, "module", "") == "__future__":
+            continue
+        line = lines[node.lineno - 1]
+        if "noqa" in line:
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = (alias.asname or alias.name).split(".")[0]
+            if bound not in used:
+                problems.append(
+                    f"line {node.lineno}: unused import '{bound}' (F401)"
+                )
+
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            problems.append(f"line {i}: trailing whitespace (W291)")
+        if stripped[: len(stripped) - len(stripped.lstrip())].count("\t"):
+            problems.append(f"line {i}: tab in indentation (W191)")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(comp, ast.Constant) and comp.value is None
+                ):
+                    problems.append(
+                        f"line {node.lineno}: comparison to None with "
+                        f"==/!= (E711)"
+                    )
+
+    assert not problems, f"{path.relative_to(REPO)}:\n" + "\n".join(problems)
