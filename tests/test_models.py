@@ -128,3 +128,81 @@ def test_grads_flow_only_through_trainable():
     assert all(np.isfinite(np.asarray(g)).all() for g in flat)
     nonzero = [float(jnp.abs(g).max()) > 0 for g in flat]
     assert all(nonzero), "some trainable params receive no gradient"
+
+
+def test_param_dtype_bfloat16_frozen_split():
+    """model.param_dtype=bfloat16 must narrow ONLY the frozen trunk and
+    reference branch; the trainable branch (and so its adam moments) stays
+    float32, and both rollout and train step still run."""
+    import jax
+
+    from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = make_config(total_steps=2, epochs=2, num_rollouts=16,
+                         chunk_size=16, batch_size=16, ppo_epochs=1)
+    config.model.param_dtype = "bfloat16"
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+
+    frozen_leaves = jax.tree_util.tree_leaves(trainer.params["frozen_base"])
+    ref_leaves = jax.tree_util.tree_leaves(trainer.params["ref"])
+    train_leaves = jax.tree_util.tree_leaves(trainer.params["trainable"])
+    assert all(x.dtype == jnp.bfloat16 for x in frozen_leaves)
+    assert all(x.dtype == jnp.bfloat16 for x in ref_leaves)
+    assert all(x.dtype == jnp.float32 for x in train_leaves)
+
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    orch.make_experience(config.method.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count == 2
+    # trainable stayed fp32 through the update
+    assert all(
+        x.dtype == jnp.float32
+        for x in jax.tree_util.tree_leaves(trainer.params["trainable"])
+    )
+
+
+def test_memory_fit_check_gptj_geometry(monkeypatch):
+    """gpt-j-6B at fp32 frozen storage (~18 GB) must fail fast with an
+    actionable error on a 16 GB device; bf16 frozen storage (~10 GB)
+    must pass. (docs/source/performance.rst "Memory fit")"""
+    import jax
+
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.data.configs import ModelSpec
+    from trlx_tpu.utils.loading import get_model
+
+    config = make_config(total_steps=2)
+    trainer = get_model(config.model.model_type)(config)
+    trainer.config.model.num_layers_unfrozen = 2
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 16 * 2**30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    gptj = ModelSpec.preset("gpt-j-6b")
+    with pytest.raises(ValueError, match="param_dtype"):
+        trainer._check_memory_fit(gptj, jnp.float32)
+    # bf16 frozen storage is NOT enough on one chip: the untied fp32
+    # trainable lm_head + adam (~2.5 GB) plus top blocks keep the total
+    # ~19 GB (docs/source/performance.rst "Memory fit")
+    with pytest.raises(ValueError, match="fsdp"):
+        trainer._check_memory_fit(gptj, jnp.bfloat16)
+    # the shipped ppo_gptj.yml mesh (fsdp=2 x tp=4) divides the params 8x
+    from trlx_tpu.parallel import build_mesh
+
+    trainer.mesh = build_mesh({"fsdp": 2, "tp": 4})
+    trainer._check_memory_fit(gptj, jnp.bfloat16)  # fits: no raise
+    trainer.mesh = None
+    # and the env override really overrides
+    monkeypatch.setenv("TRLX_TPU_SKIP_MEMCHECK", "1")
+    trainer._check_memory_fit(gptj, jnp.float32)

@@ -381,10 +381,13 @@ def ilql_params_from_trunk(
 
 
 def hydra_params_from_trunk(
-    policy, embed: Params, blocks: Params, ln_f: Params, rng
+    policy, embed: Params, blocks: Params, ln_f: Params, rng,
+    frozen_dtype=None,
 ) -> Params:
     """Assemble the hydra param split from an imported trunk: bottom frozen,
-    top trainable, ref = copy of top; fresh value head."""
+    top trainable, ref = copy of top; fresh value head. `frozen_dtype`
+    narrows the storage of the frozen bottom + embeddings (the trainable
+    top stays as imported — float32)."""
     import jax
     import jax.numpy as jnp
 
@@ -396,7 +399,13 @@ def hydra_params_from_trunk(
     top = jax.tree_util.tree_map(lambda x: jnp.asarray(x[spec.n_layer - k :]), blocks)
     ln_f = as_jnp(ln_f)
     embed = dict(as_jnp(embed))
-    lm_head = embed.pop("lm_head", None)
+    lm_head = embed.pop("lm_head", None)  # trainable: stays as imported
+    if frozen_dtype is not None:
+        cast = lambda tree: jax.tree_util.tree_map(
+            lambda x: x.astype(frozen_dtype), tree
+        )
+        bottom = cast(bottom)
+        embed = cast(embed)
 
     trainable: Params = {
         "blocks": top,
@@ -410,6 +419,12 @@ def hydra_params_from_trunk(
     if lm_head is not None:
         trainable["lm_head"] = lm_head
         ref["lm_head"] = jax.tree_util.tree_map(jnp.copy, lm_head)
+    if frozen_dtype is not None:
+        # the ref branch is frozen too — same storage dtype as the trunk
+        # (matches HydraPolicy._init and the ModelConfig.param_dtype docs)
+        ref = jax.tree_util.tree_map(
+            lambda x: x.astype(frozen_dtype), ref
+        )
     return {
         "frozen_base": {"embed": embed, "blocks": bottom},
         "trainable": trainable,

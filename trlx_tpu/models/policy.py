@@ -75,17 +75,27 @@ class HydraPolicy:
 
     # -- init ---------------------------------------------------------------
 
-    def init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+    def init(self, rng: jax.Array, param_dtype=jnp.float32,
+             frozen_dtype=None) -> Params:
         """Jitted init: one compiled program instead of hundreds of eager
-        dispatches (eager-op overhead dominates otherwise)."""
-        return _jitted_init(self, param_dtype)(rng)
+        dispatches (eager-op overhead dominates otherwise).
+
+        `frozen_dtype` (default: param_dtype) stores the frozen trunk and
+        reference branch in a narrower dtype than the trainable top — the
+        memory-fit lever for 6B-class models on one chip: the frozen ~L-k
+        layers are never updated, so bf16 storage costs nothing in
+        optimizer quality, while the trainable branch (and its adam
+        moments) stays float32."""
+        return _jitted_init(self, param_dtype, frozen_dtype)(rng)
 
     def jit_forward(self, with_ref: bool = True):
         """A cached, jitted forward(params, tokens, attention_mask)."""
         return _jitted_forward(self, with_ref)
 
-    def _init(self, rng: jax.Array, param_dtype=jnp.float32) -> Params:
+    def _init(self, rng: jax.Array, param_dtype=jnp.float32,
+              frozen_dtype=None) -> Params:
         spec, k = self.spec, self.k
+        frozen_dtype = frozen_dtype or param_dtype
         k_embed, k_blocks, k_head = jax.random.split(rng, 3)
         embed = init_embed_params(k_embed, spec, param_dtype)
         blocks = init_block_params(k_blocks, spec, spec.n_layer, param_dtype)
@@ -106,11 +116,18 @@ class HydraPolicy:
         if lm_head is not None:
             trainable["lm_head"] = lm_head
             ref["lm_head"] = jax.tree_util.tree_map(jnp.copy, lm_head)
-        return {
+        params = {
             "frozen_base": {"embed": embed, "blocks": bottom},
             "trainable": trainable,
             "ref": ref,
         }
+        if frozen_dtype != param_dtype:
+            cast = functools.partial(
+                jax.tree_util.tree_map, lambda x: x.astype(frozen_dtype)
+            )
+            params["frozen_base"] = cast(params["frozen_base"])
+            params["ref"] = cast(params["ref"])
+        return params
 
     # -- forward ------------------------------------------------------------
 
@@ -187,11 +204,14 @@ class HydraPolicy:
 
     def all_blocks(self, params: Params) -> Params:
         """Bottom + trainable top stacked into one [L, ...] tree — the live
-        policy the decode engine runs."""
+        policy the decode engine runs. Under a mixed frozen_dtype the
+        trainable top is cast down to the frozen storage dtype (decode
+        computes in bf16 anyway)."""
         bottom = params["frozen_base"]["blocks"]
         top = params["trainable"]["blocks"]
         return jax.tree_util.tree_map(
-            lambda a, b: jnp.concatenate([a, b], axis=0), bottom, top
+            lambda a, b: jnp.concatenate([a, b.astype(a.dtype)], axis=0),
+            bottom, top,
         )
 
     def head_params_for_decode(self, params: Params) -> Tuple[Params, Params]:
@@ -203,8 +223,8 @@ class HydraPolicy:
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_init(policy: HydraPolicy, param_dtype):
-    return jax.jit(lambda rng: policy._init(rng, param_dtype))
+def _jitted_init(policy: HydraPolicy, param_dtype, frozen_dtype=None):
+    return jax.jit(lambda rng: policy._init(rng, param_dtype, frozen_dtype))
 
 
 @functools.lru_cache(maxsize=None)
