@@ -19,6 +19,8 @@ Registered under "JaxILQLTrainer" and the reference name "ILQLModel".
 
 from typing import Callable, Dict, Optional
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -169,7 +171,19 @@ class JaxILQLTrainer(BaseRLTrainer):
                 gen_config, compute_dtype=net.compute_dtype, extras_fn=extras,
             )
 
+        def train_step_indexed(params, opt_state, dataset: ILQLBatch, idx):
+            """Train on dataset rows `idx` — the dataset stays device-
+            resident across the whole run and the host sends only a [B]
+            index array per step (a sync on tunneled/remote devices costs
+            ~100 ms regardless of payload, so per-batch uploads dominate
+            the loop otherwise)."""
+            batch = jax.tree_util.tree_map(lambda x: x[idx], dataset)
+            return train_step(params, opt_state, batch)
+
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._train_step_indexed = jax.jit(
+            train_step_indexed, donate_argnums=(0, 1)
+        )
         self._sync = jax.jit(lambda p: sync_targets(p, m.alpha))
         self._generate_fn = generate_fn
         self._generate_jitted = {}
@@ -323,26 +337,59 @@ class JaxILQLTrainer(BaseRLTrainer):
         # byte pad 256 vs a tiny graph vocab would otherwise overflow
         pad_id = min(eos, self.net.spec.vocab_size - 1)
         sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+
+        # collate + upload the WHOLE offline dataset once (rows pad to the
+        # store-global max length, so per-batch shapes are identical);
+        # every train step then sends only a [batch] index array. Rows are
+        # padded (repeat-last) to the mesh's dp*fsdp multiple for
+        # shard_batch; indices only ever address the n real rows. Datasets
+        # too large to sit in HBM next to params+opt keep the per-batch
+        # upload path.
+        from trlx_tpu.pipeline import batch_iterator
+
+        n = len(self.train_store)
+        full = next(iter(self.train_store.create_loader(
+            n, shuffle=False, eos_token_id=pad_id, pad_to_multiple=sp,
+        )))
+        dataset_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(full)
+        )
+        device_resident = dataset_bytes <= int(os.environ.get(
+            "TRLX_TPU_DATASET_HBM_BYTES", 512 * 2**20
+        ))
+        if device_resident:
+            padded, _ = self._pad_rows(full)
+            dataset = self._put(padded)
+
         for epoch in range(cfg.epochs):
-            loader = self.train_store.create_loader(
-                cfg.batch_size, shuffle=True, seed=epoch, eos_token_id=pad_id,
+            idx_loader = batch_iterator(
+                n, cfg.batch_size, True, epoch, lambda idx: idx,
                 # a partial final batch can't shard over (dp, fsdp)
                 drop_last=self.mesh is not None,
-                # ring attention needs the padded length divisible by sp
-                pad_to_multiple=sp,
             )
-            for batch in loader:
+            for idx in idx_loader:
                 if self.iter_count % cfg.eval_interval == 0:
                     ev = self.evaluate()
                     if ev:
                         log_fn({"iter": self.iter_count, **ev})
 
-                jbatch = self._put(batch)
-                self.params, self.opt_state, stats = self._train_step(
-                    self.params, self.opt_state, jbatch
-                )
+                if device_resident:
+                    self.params, self.opt_state, stats = (
+                        self._train_step_indexed(
+                            self.params, self.opt_state, dataset,
+                            jnp.asarray(idx, jnp.int32),
+                        )
+                    )
+                else:
+                    batch = jax.tree_util.tree_map(
+                        lambda x: x[idx], full
+                    )
+                    self.params, self.opt_state, stats = self._train_step(
+                        self.params, self.opt_state, self._put(batch)
+                    )
                 self.iter_count += 1
-                clock.tick(len(batch.input_ids))
+                clock.tick(len(idx))
 
                 if self.iter_count % m.steps_for_target_q_sync == 0:
                     self.params = self._sync(self.params)
