@@ -67,3 +67,17 @@ def test_profiling_writes_trace(tmp_path, monkeypatch):
             (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
     produced = list(tmp_path.rglob("*"))
     assert produced, "no trace files written"
+
+
+def test_sentiment_score_parity():
+    """[-1,1] scores from HF sentiment pipeline output: NEGATIVE label
+    negates (parity: reference trlx/utils/__init__.py:109-116)."""
+    from trlx_tpu.utils import sentiment_score
+
+    out = sentiment_score([
+        {"label": "NEGATIVE", "score": 0.9},
+        {"label": "POSITIVE", "score": 0.7},
+        {"label": "neutral-ish", "score": 0.2},
+    ])
+    np.testing.assert_allclose(out, [-0.9, 0.7, 0.2], rtol=1e-6)
+    assert out.dtype == np.float32

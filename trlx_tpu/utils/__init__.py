@@ -62,6 +62,18 @@ def cosine_schedule(lr_init: float, total_steps: int, lr_min: float = 1e-9) -> o
     )
 
 
+def sentiment_score(sentiments: Iterable[dict]) -> np.ndarray:
+    """Scores in [-1, 1] from HF sentiment-analysis pipeline output:
+    negative labels contribute -score, others +score
+    (parity: reference trlx/utils/__init__.py:109-116; numpy array in
+    place of a torch tensor)."""
+    return np.asarray(
+        [-s["score"] if s["label"] == "NEGATIVE" else s["score"]
+         for s in sentiments],
+        np.float32,
+    )
+
+
 def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
     """Keep the top-k entries of the last axis, set the rest to -inf
     (parity: reference utils/__init__.py:94-103). Uses lax.top_k rather
