@@ -1,0 +1,139 @@
+"""Exercise the examples' ONLINE glue offline, with mocked HF assets.
+
+The online paths (HF sentiment pipeline + IMDB prompts) can never run in a
+no-egress environment, so their first real execution would otherwise be on
+a user's machine. These tests drive the exact online_pieces wiring —
+dataset filtering, reward_fn construction and conventions, prompt shaping —
+against tiny local fakes of `transformers.pipeline` and
+`datasets.load_dataset`, then run the resulting pieces through one real
+rollout+learn pass on the tiny offline model.
+"""
+
+import importlib.util
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def load_example(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_ex_{name}", REPO / "examples" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeSentimentPipe:
+    """Mimics transformers sentiment pipeline output: per-sample
+    [{label: NEGATIVE, score}, {label: POSITIVE, score}]. Positive score =
+    lowercase ratio, so learning signals stay deterministic."""
+
+    def __call__(self, samples, return_all_scores=True, batch_size=32,
+                 **kw):
+        out = []
+        for s in samples:
+            pos = float(np.mean([c.islower() for c in s] or [0.0]))
+            out.append([
+                {"label": "NEGATIVE", "score": 1.0 - pos},
+                {"label": "POSITIVE", "score": pos},
+            ])
+        return out
+
+
+def install_fake_hf(monkeypatch, texts):
+    fake_tf = types.ModuleType("transformers")
+    fake_tf.pipeline = lambda *a, **k: FakeSentimentPipe()
+    fake_ds = types.ModuleType("datasets")
+
+    class DS(dict):
+        pass
+
+    def load_dataset(name, split=None):
+        return {"text": texts}
+
+    fake_ds.load_dataset = load_dataset
+    monkeypatch.setitem(sys.modules, "transformers", fake_tf)
+    monkeypatch.setitem(sys.modules, "datasets", fake_ds)
+
+
+def test_ppo_sentiments_online_glue(monkeypatch):
+    mod = load_example("ppo_sentiments")
+    texts = ["a lovely film" * 3, "TERRIBLE MOVIE", "x" * 600, "ok movie"]
+    install_fake_hf(monkeypatch, texts)
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.load_yaml(str(REPO / "configs" / "ppo_config.yml"))
+    reward_fn, prompts = mod.online_pieces(config)
+    # the reference's <500-char filter applies
+    assert "x" * 600 not in prompts and len(prompts) == 3
+    scores = reward_fn(["abc", "ABC"])
+    assert scores[0] == pytest.approx(1.0)
+    assert scores[1] == pytest.approx(0.0)
+
+
+def test_ilql_sentiments_online_glue(monkeypatch):
+    mod = load_example("ilql_sentiments")
+    texts = ["nice and calm", "LOUD TEXT", "y" * 501]
+    install_fake_hf(monkeypatch, texts)
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.load_yaml(str(REPO / "configs" / "ilql_config.yml"))
+    reward_fn, train_samples, eval_prompts = mod.online_pieces(config)
+    assert train_samples == ["nice and calm", "LOUD TEXT"]
+    assert len(eval_prompts) == 64
+    # token-row inputs (eval generations) decode before scoring
+    rows = [[ord(c) for c in "abc"], [ord(c) for c in "ABC"]]
+    scores = reward_fn(rows)
+    assert scores[0] == pytest.approx(1.0)
+    assert scores[1] == pytest.approx(0.0)
+
+
+def test_ppo_sentiments_online_pieces_drive_end_to_end(monkeypatch):
+    """The mocked online reward_fn must run a REAL rollout+learn pass
+    (tiny model) — the full online wiring minus the network."""
+    mod = load_example("ppo_sentiments")
+    texts = ["good words here", "MORE WORDS", "fine film indeed"] * 40
+    install_fake_hf(monkeypatch, texts)
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+
+    config = TRLConfig.load_yaml(str(REPO / "configs" / "ppo_config.yml"))
+    reward_fn, prompts = mod.online_pieces(config)
+    # shrink the model/run like offline_pieces does, but keep the ONLINE
+    # reward_fn + prompts
+    config.model.model_spec = {"vocab_size": 257, "n_layer": 2,
+                               "n_head": 4, "d_model": 64,
+                               "n_positions": 32}
+    config.model.tokenizer_path = "byte"
+    config.model.compute_dtype = "float32"
+    config.train.total_steps = 2
+    config.train.epochs = 2
+    config.train.batch_size = 16
+    config.train.input_size = 4
+    config.train.gen_size = 8
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    config.method.gen_kwargs.update(max_length=8, min_length=8)
+    trainer = get_model(config.model.model_type)(config)
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert 0.0 <= info["mean_score"] <= 1.0
+    trainer.learn(log_fn=lambda s: None)
+    # one minibatch x ppo_epochs(4) in one fused dispatch; total_steps=2
+    # is crossed mid-batch exactly like the reference's inner loop
+    assert trainer.iter_count == 4

@@ -269,3 +269,38 @@ def test_adaptive_kl_cadence_regimes_match():
         ratio.min(), ratio.max())
     # and the dynamics actually exercised the controller (rose then fell)
     assert repo_path.max() > 0.21 and repo_path[-1] < repo_path.max()
+
+
+def test_gae_matmul_path_matches_scan_at_long_T():
+    """The closed-form MXU matmul must track the sequential recurrence to
+    float32 accuracy at realistic lengths (default matmul precision would
+    truncate to bfloat16 and drift ~1e-2 — precision=HIGHEST is load-
+    bearing), and the beyond-threshold scan path must agree too."""
+    import trlx_tpu.ops.losses as L
+
+    rng = np.random.default_rng(0)
+    B, T = 4, 300
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    rewards = rng.normal(size=(B, T)).astype(np.float32) * 0.1
+    gamma, lam = 0.99, 0.95
+
+    # numpy reference recurrence
+    v_next = np.concatenate([values[:, 1:], np.zeros((B, 1), np.float32)], 1)
+    deltas = rewards + gamma * v_next - values
+    ref = np.zeros_like(deltas)
+    acc = np.zeros(B, np.float64)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[:, t] + gamma * lam * acc
+        ref[:, t] = acc
+
+    adv_matmul, _ = L.gae_advantages(values, rewards, gamma, lam)
+    np.testing.assert_allclose(np.asarray(adv_matmul), ref, atol=5e-4)
+
+    old = L._GAE_MATMUL_MAX_T
+    try:
+        L._GAE_MATMUL_MAX_T = 0  # force the scan path
+        adv_scan, _ = L.gae_advantages(values, rewards, gamma, lam)
+    finally:
+        L._GAE_MATMUL_MAX_T = old
+    np.testing.assert_allclose(np.asarray(adv_matmul), np.asarray(adv_scan),
+                               atol=5e-4)

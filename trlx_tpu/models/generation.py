@@ -52,9 +52,12 @@ Params = Dict[str, Any]
 
 # Above this depth the decode body switches from an unrolled layer loop to a
 # fori_loop: the unrolled program grows linearly with depth (compile time and
-# serialized-HLO size — remote-compile services cap payloads), while fori
-# stays O(1) with near-identical step time at large L.
-_UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "24"))
+# serialized-HLO size), while fori stays O(1). Unrolling wins meaningfully as
+# deep as measured — gpt2-xl's 48 layers decode 1.6x faster unrolled (9.7 vs
+# 15.7 ms/step at [B=128, S=52] on v5e) — so the default covers every model
+# family the framework ships presets for; fori remains the safety valve for
+# far deeper stacks.
+_UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "48"))
 
 
 class GenerationConfig(NamedTuple):
