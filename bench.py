@@ -559,6 +559,17 @@ def bench_quality(cycles=50):
     }
 
 
+def _reclaim_device_memory():
+    """Drop dead leg-local trainers' device buffers before the next leg.
+
+    A failed (e.g. OOM'd) leg otherwise poisons everything after it: the
+    exception's traceback frames pin the leg's params/optimizer until GC
+    runs, and the guarded legs each build multi-GB trainers."""
+    import gc
+
+    gc.collect()
+
+
 def main():
     import jax
 
@@ -628,6 +639,7 @@ def main():
     except Exception as e:  # must not sink the headline metric
         log(f"long-context bench skipped: {e!r}")
         long_ctx = {}
+    _reclaim_device_memory()
 
     # ---- ILQL train step --------------------------------------------------
     try:
@@ -635,6 +647,7 @@ def main():
     except Exception as e:
         log(f"ilql bench skipped: {e!r}")
         ilql = {}
+    _reclaim_device_memory()
 
     # ---- gpt2-xl (the BASELINE north-star model) --------------------------
     try:
@@ -642,6 +655,7 @@ def main():
     except Exception as e:
         log(f"gpt2-xl bench skipped: {e!r}")
         xl = {}
+    _reclaim_device_memory()
 
     # ---- full rollout+update cycles (the headline) -----------------------
     cycles = 3
@@ -670,6 +684,7 @@ def main():
     except Exception as e:
         log(f"quality leg skipped: {e!r}")
         quality = {}
+    _reclaim_device_memory()
 
     metric = "ppo_rollout_update_samples_per_sec"
     prev, prev_src = previous_round_value(metric)

@@ -51,9 +51,6 @@ def install_fake_hf(monkeypatch, texts):
     fake_tf.pipeline = lambda *a, **k: FakeSentimentPipe()
     fake_ds = types.ModuleType("datasets")
 
-    class DS(dict):
-        pass
-
     def load_dataset(name, split=None):
         return {"text": texts}
 

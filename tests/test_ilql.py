@@ -278,3 +278,36 @@ def test_evaluate_caps_eval_set_at_128():
     trainer.evaluate(n=0)
     # calls[0] is the orchestrator scoring the training walks at build time
     assert calls[-2:] == [128, 150], calls
+
+
+def test_ilql_dataset_upload_fallback_matches_device_resident(monkeypatch):
+    """Training must be bit-identical whether the offline dataset is
+    device-resident (indexed gathers) or re-uploaded per batch (the
+    TRLX_TPU_DATASET_HBM_BYTES fallback for corpora too large for HBM)."""
+    import jax
+
+    from trlx_tpu.utils.loading import get_model, get_orchestrator
+
+    def run(env_bytes):
+        if env_bytes is None:
+            monkeypatch.delenv("TRLX_TPU_DATASET_HBM_BYTES", raising=False)
+        else:
+            monkeypatch.setenv("TRLX_TPU_DATASET_HBM_BYTES", str(env_bytes))
+        walks, logit_mask, stats_fn, reward_fn = generate_random_walks(
+            seed=11
+        )
+        config = rw_config(logit_mask.shape[0], epochs=2)
+        trainer = get_model("JaxILQLTrainer")(config, logit_mask=logit_mask)
+        eval_prompts = np.arange(1, logit_mask.shape[0]).reshape(-1, 1)
+        get_orchestrator("OfflineOrchestrator")(
+            trainer, walks, eval_prompts, reward_fn=reward_fn,
+            stats_fn=stats_fn,
+        )
+        trainer.learn(log_fn=lambda s: None)
+        return [np.asarray(x) for x in
+                jax.tree_util.tree_leaves(trainer.params)]
+
+    resident = run(None)        # default 512 MB: dataset fits, stays on device
+    fallback = run(0)           # force the per-batch upload path
+    for a, b in zip(resident, fallback):
+        np.testing.assert_array_equal(a, b)

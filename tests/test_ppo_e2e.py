@@ -207,3 +207,36 @@ def test_evaluate_rotates_prompts_across_eval_points():
         trainer.reward_fn = orig_reward
     assert len(seen) == 3
     assert len(set(seen)) > 1, "every eval point scored the same prompts"
+
+
+def test_eos_terminated_rollouts_end_to_end():
+    """Variable-length generation (eos enabled, min_length < max_length):
+    rollouts carry real per-row response masks and the full
+    rollout -> finalize -> GAE -> update path stays finite."""
+    config = make_config(total_steps=2, epochs=2, ppo_epochs=1,
+                         num_rollouts=16, chunk_size=16, batch_size=16)
+    config.method.gen_kwargs.update(min_length=0, max_length=8)
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    # eos that the random policy will actually hit: byte 65 ('A')
+    trainer.gen_config = trainer.gen_config._replace(
+        eos_token_id=65, min_new_tokens=0)
+    trainer._build_jitted_fns()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert np.isfinite(info["mean_score"])
+    batch = next(iter(trainer.store.create_loader(16)))
+    masks = np.asarray(batch.response_masks)
+    lengths = masks.sum(axis=1)
+    assert lengths.min() < masks.shape[1], "no row ever terminated early"
+    # rewards only on real tokens
+    rewards = np.asarray(batch.rewards)
+    assert np.allclose(rewards[masks == 0], 0.0, atol=1e-6)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count >= 1

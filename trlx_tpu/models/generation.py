@@ -51,13 +51,14 @@ from trlx_tpu.ops.sampling import SamplingParams, sample_token
 Params = Dict[str, Any]
 
 # Above this depth the decode body switches from an unrolled layer loop to a
-# fori_loop: the unrolled program grows linearly with depth (compile time and
-# serialized-HLO size), while fori stays O(1). Unrolling wins meaningfully as
-# deep as measured — gpt2-xl's 48 layers decode 1.6x faster unrolled (9.7 vs
-# 15.7 ms/step at [B=128, S=52] on v5e) — so the default covers every model
-# family the framework ships presets for; fori remains the safety valve for
-# far deeper stacks.
-_UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "48"))
+# fori_loop. Unrolling is faster as deep as measured (gpt2-xl's 48 layers:
+# 9.7 vs 15.7 ms/step at [B=128, S=52] on v5e) but the unrolled body also
+# extends buffer live ranges: the same xl decode that wins in isolation
+# OOMs a 16 GB chip once 6 GB of params + optimizer + hydra ref share the
+# HBM. The default keeps deep models on the O(1)-memory fori path; raise
+# TRLX_TPU_DECODE_UNROLL_MAX when decode headroom allows (decode-only
+# servers, sharded params).
+_UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "24"))
 
 
 class GenerationConfig(NamedTuple):
