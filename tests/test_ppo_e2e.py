@@ -240,3 +240,51 @@ def test_eos_terminated_rollouts_end_to_end():
     assert np.allclose(rewards[masks == 0], 0.0, atol=1e-6)
     trainer.learn(log_fn=lambda s: None)
     assert trainer.iter_count >= 1
+
+
+def test_make_experience_crosses_host_boundary_twice_per_chunk(monkeypatch):
+    """Architecture guard: one device_get (sequences + seq_kl) and one
+    host->device scores transfer per rollout chunk — per-token
+    logprobs/values/rewards must never round-trip through the host
+    (each sync on tunneled/remote TPUs costs ~100 ms regardless of size)."""
+    import jax
+
+    import trlx_tpu.orchestrator.ppo_orchestrator as orch_mod
+
+    config, trainer, pipeline, orch = build()
+    orch._bank = None  # force a fresh bank upload outside the counter
+    orch._idx_loader = None
+    bank = orch._prompt_bank()  # uploaded once, not per chunk
+
+    fetches = []
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        fetches.append(jax.tree_util.tree_leaves(x))
+        return real_device_get(x)
+
+    monkeypatch.setattr(orch_mod.jax, "device_get", counting_device_get)
+
+    finals = []
+    real_finalize = trainer.finalize_rewards
+    monkeypatch.setattr(
+        trainer, "finalize_rewards",
+        lambda *a: (finals.append(1), real_finalize(*a))[1],
+    )
+
+    n_chunks = 2
+    trainer.store.clear_history()
+    orch.make_experience(n_chunks * orch.chunk_size)
+
+    assert len(fetches) == n_chunks, "expected ONE device_get per chunk"
+    assert len(finals) == n_chunks, "expected ONE scores dispatch per chunk"
+    for leaves in fetches:
+        fetched = sum(np.asarray(leaf).nbytes for leaf in leaves)
+        # sequences [B, P+G] int32 + seq_kl [B] f32 and nothing bigger
+        B = orch.chunk_size
+        expected_max = B * (config.train.input_size
+                            + config.train.gen_size) * 4 + B * 4
+        assert fetched <= expected_max, (
+            f"per-chunk fetch grew to {fetched} bytes - per-token arrays "
+            f"are leaking into the host round trip"
+        )

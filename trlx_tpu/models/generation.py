@@ -235,9 +235,14 @@ def generate(
             step_logits = step_logits.at[:, config.eos_token_id].set(
                 jnp.where(suppress, NEG_INF, eos_col)
             )
-        tok = sample_token(key, step_logits, config.sampling)
+        # one log_softmax serves both the draw and the recorded (unwarped)
+        # logprob: every warper and categorical() itself is invariant to
+        # the per-row logsumexp shift, so sampling from the normalized
+        # distribution is identical and skips a second full-vocab pass
+        step_lsm = jax.nn.log_softmax(step_logits, axis=-1)
+        tok = sample_token(key, step_lsm, config.sampling)
         logprob = jnp.take_along_axis(
-            jax.nn.log_softmax(step_logits, axis=-1), tok[:, None], axis=-1
+            step_lsm, tok[:, None], axis=-1
         )[:, 0]
         tok = jnp.where(finished, jnp.int32(config.pad_token_id), tok)
         logprob = jnp.where(finished, 0.0, logprob)
