@@ -317,9 +317,38 @@ def bench_ilql():
     dt = (time.perf_counter() - t0) / reps
     log(f"ilql train_step (gpt2-124M, [{B},{T}]): {dt*1e3:.1f} ms "
         f"({B*T/dt:,.0f} tok/s)")
+
+    # the full learn LOOP over a device-resident offline dataset (one
+    # upload; per-step the host sends only a [batch] index array) — the
+    # loop datum the per-step figure above cannot show
+    from trlx_tpu.utils.loading import get_orchestrator
+
+    trainer.params, trainer.opt_state = params, opt_state
+    rng2 = np.random.default_rng(1)
+    n_samples = 2048
+    samples = [rng2.integers(1, 200, size=rng2.integers(24, T)).tolist()
+               for _ in range(n_samples)]
+    get_orchestrator("OfflineOrchestrator")(
+        trainer, samples, [],  # no eval prompts: keep the loop pure train
+        reward_fn=lambda rows: [float(len(r)) for r in rows],
+    )
+    trainer.config.train.total_steps = 1
+    trainer.learn(log_fn=lambda s: None)  # warm: compile + dataset upload
+    jax.block_until_ready(trainer.params["trainable"])
+    trainer.config.train.total_steps = 10**9  # timed run bound by the data
+    trainer.iter_count = 0
+    t0 = time.perf_counter()
+    trainer.learn(log_fn=lambda s: None)
+    np.asarray(jax.tree_util.tree_leaves(trainer.params["trainable"])[0][:1])
+    loop_dt = time.perf_counter() - t0
+    steps = max(trainer.iter_count, 1)
+    sps = steps * B / loop_dt
+    log(f"ilql learn loop: {steps} steps over {n_samples} samples in "
+        f"{loop_dt:.2f}s -> {sps:,.0f} samples/s/chip")
     return {
         "ilql_train_ms": round(dt * 1e3, 1),
         "ilql_tokens_per_sec": round(B * T / dt, 1),
+        "ilql_learn_samples_per_sec": round(sps, 1),
     }
 
 
@@ -634,28 +663,34 @@ def main():
         f"{f', MFU {train_mfu:.1%}' if train_mfu else ''}")
 
     # ---- long-context train step (fused Pallas attention path) -----------
+    t_leg = time.perf_counter()
     try:
         long_ctx = bench_long_context(peak)
     except Exception as e:  # must not sink the headline metric
         log(f"long-context bench skipped: {e!r}")
         long_ctx = {}
     _reclaim_device_memory()
+    log(f"[leg] long-context: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- ILQL train step --------------------------------------------------
+    t_leg = time.perf_counter()
     try:
         ilql = bench_ilql()
     except Exception as e:
         log(f"ilql bench skipped: {e!r}")
         ilql = {}
     _reclaim_device_memory()
+    log(f"[leg] ilql: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- gpt2-xl (the BASELINE north-star model) --------------------------
+    t_leg = time.perf_counter()
     try:
         xl = bench_gpt2_xl()
     except Exception as e:
         log(f"gpt2-xl bench skipped: {e!r}")
         xl = {}
     _reclaim_device_memory()
+    log(f"[leg] gpt2-xl: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- full rollout+update cycles (the headline) -----------------------
     cycles = 3
@@ -679,12 +714,14 @@ def main():
     samples_per_sec = m.num_rollouts / best
 
     # ---- quality: mean-reward + KL learning curve (~200 steps) -----------
+    t_leg = time.perf_counter()
     try:
         quality = bench_quality()
     except Exception as e:
         log(f"quality leg skipped: {e!r}")
         quality = {}
     _reclaim_device_memory()
+    log(f"[leg] quality: {time.perf_counter() - t_leg:.0f}s")
 
     metric = "ppo_rollout_update_samples_per_sec"
     prev, prev_src = previous_round_value(metric)

@@ -172,3 +172,37 @@ def test_resume_from_kill_and_continue(tmp_path):
     orch2.make_experience(config2.method.num_rollouts)
     resumed.learn(log_fn=lambda s: None)
     assert resumed.iter_count == 12
+
+
+def test_save_restore_preserves_mixed_param_dtypes(tmp_path):
+    """param_dtype=bfloat16 stores the frozen trunk/ref narrow while the
+    trainable branch stays fp32; a checkpoint round-trip must restore the
+    exact mixed-dtype layout and values."""
+    import jax
+    import jax.numpy as jnp
+
+    def bf16_config(seed):
+        config = make_config(total_steps=8, epochs=2, num_rollouts=16,
+                             chunk_size=16, batch_size=16, ppo_epochs=1)
+        config.train.seed = seed
+        config.train.checkpoint_dir = str(tmp_path / "ckpt")
+        config.model.param_dtype = "bfloat16"
+        return config
+
+    config = bf16_config(0)
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    trainer.save()
+
+    resumed = get_model(config.model.model_type)(bf16_config(3))
+    resumed.tokenizer = ByteTokenizer()
+    resumed.load(config.train.checkpoint_dir)
+
+    for part, want in (("frozen_base", jnp.bfloat16),
+                       ("ref", jnp.bfloat16),
+                       ("trainable", jnp.float32)):
+        leaves = jax.tree_util.tree_leaves(resumed.params[part])
+        assert all(x.dtype == want for x in leaves), part
+        for a, b in zip(jax.tree_util.tree_leaves(trainer.params[part]),
+                        leaves):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
