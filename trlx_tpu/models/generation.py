@@ -51,11 +51,14 @@ from trlx_tpu.ops.sampling import SamplingParams, sample_token
 Params = Dict[str, Any]
 
 # Above this depth the decode body switches from an unrolled layer loop to a
-# fori_loop. Unrolling is faster as deep as measured (gpt2-xl's 48 layers:
-# 9.7 vs 15.7 ms/step at [B=128, S=52] on v5e) but the unrolled body also
-# extends buffer live ranges: the same xl decode that wins in isolation
-# OOMs a 16 GB chip once 6 GB of params + optimizer + hydra ref share the
-# HBM. The default keeps deep models on the O(1)-memory fori path; raise
+# fori_loop. What makes the unrolled path fast is the per-layer TUPLE cache
+# leaves in the scan carry (measured: gpt2-xl 48L 9.7-11.8 ms/step unrolled
+# vs 14.7-15.7 for every stacked-carry variant, including group-chunked
+# unrolls — dynamic_update_index on a stacked cache costs the same as fori).
+# But the unrolled body also extends buffer live ranges: the same xl decode
+# that wins in isolation OOMs a 16 GB chip inside the fused rollout program,
+# where the scoring forward's [B, T, V] logits buffers share the peak. The
+# default keeps deep models on the O(1)-memory fori path; raise
 # TRLX_TPU_DECODE_UNROLL_MAX when decode headroom allows (decode-only
 # servers, sharded params).
 _UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "24"))
