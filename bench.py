@@ -352,14 +352,6 @@ def bench_ilql():
     }
 
 
-def tree_bytes(tree):
-    import jax
-
-    return sum(
-        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
-    )
-
-
 def bench_gpt2_xl():
     """The BASELINE.md north-star model: ppo_sentiments at gpt2-xl (1.5B)
     scale, same workload shape, on the one chip. Guarded — the headline
@@ -432,6 +424,8 @@ def bench_gpt2_xl():
     # the hydra split — fp32 params for the FULL model, but adam moments
     # only for the trainable top (num_layers_unfrozen=2 + heads), and a
     # [L, B, S, H, hd] bf16 KV cache sized to prompt+gen (52), not n_ctx
+    from trlx_tpu.utils import tree_bytes
+
     params_gb = tree_bytes(trainer.params) / 2**30
     opt_gb = tree_bytes(trainer.opt_state) / 2**30
     s = config.train.input_size + config.train.gen_size

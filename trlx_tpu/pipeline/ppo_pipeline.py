@@ -17,6 +17,7 @@ deliberate:
 from typing import Iterator, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.data.ppo_types import PPORLBatch
@@ -116,8 +117,6 @@ class PPORolloutStorage(BaseRolloutStore):
             # device-resident chunks stay on device (np.concatenate would
             # silently pull every chunk through the host)
             if any(isinstance(x, jax.Array) for x in xs):
-                import jax.numpy as jnp
-
                 return jnp.concatenate(xs, axis=0)
             return np.concatenate(xs, axis=0)
 

@@ -17,9 +17,8 @@ TPU-first differences:
 Registered under "JaxILQLTrainer" and the reference name "ILQLModel".
 """
 
-from typing import Callable, Dict, Optional
-
 import os
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -351,11 +350,9 @@ class JaxILQLTrainer(BaseRLTrainer):
         full = next(iter(self.train_store.create_loader(
             n, shuffle=False, eos_token_id=pad_id, pad_to_multiple=sp,
         )))
-        dataset_bytes = sum(
-            x.size * x.dtype.itemsize
-            for x in jax.tree_util.tree_leaves(full)
-        )
-        device_resident = dataset_bytes <= int(os.environ.get(
+        from trlx_tpu.utils import tree_bytes
+
+        device_resident = tree_bytes(full) <= int(os.environ.get(
             "TRLX_TPU_DATASET_HBM_BYTES", 512 * 2**20
         ))
         if device_resident:

@@ -157,8 +157,6 @@ class JaxPPOTrainer(BaseRLTrainer):
     def set_logit_mask(self, mask) -> None:
         """Restrict sampling to tokens where mask is True (e.g. graph edges,
         printable subsets). Rebuilds the jitted generation closure."""
-        import jax.numpy as jnp
-
         self.logit_mask = None if mask is None else jnp.asarray(mask)
         self._build_jitted_fns()
 
@@ -353,7 +351,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         device-resident bank, generate, score). Returns DEVICE arrays
         (out, query, query_mask, logprobs, values, kl_rewards, seq_kl) — no
         host sync; the orchestrator batches the one fetch it needs."""
-        idx = jnp.asarray(np.asarray(idx, np.int32))
+        idx = jnp.asarray(idx, dtype=jnp.int32)
         return self._rollout_fn(
             self.params, bank_tokens, bank_mask, idx, self.next_rng(),
             jnp.float32(self.kl_ctl.value),

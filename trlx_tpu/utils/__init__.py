@@ -62,6 +62,14 @@ def cosine_schedule(lr_init: float, total_steps: int, lr_min: float = 1e-9) -> o
     )
 
 
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (params, opt state,
+    datasets) — the quantity HBM budgeting decisions are made on."""
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
 def sentiment_score(sentiments: Iterable[dict]) -> np.ndarray:
     """Scores in [-1, 1] from HF sentiment-analysis pipeline output:
     negative labels contribute -score, others +score
