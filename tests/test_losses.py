@@ -304,3 +304,29 @@ def test_gae_matmul_path_matches_scan_at_long_T():
         L._GAE_MATMUL_MAX_T = old
     np.testing.assert_allclose(np.asarray(adv_matmul), np.asarray(adv_scan),
                                atol=5e-4)
+
+
+def test_chunked_label_logprobs_matches_full_logits():
+    """The chunked scoring path must reproduce logprobs_from_logits(head(h))
+    exactly — including ragged T not divisible by the chunk and
+    out-of-vocab labels (mode=clip semantics)."""
+    from trlx_tpu.ops.losses import chunked_label_logprobs
+
+    rng = np.random.default_rng(2)
+    B, T, D, V = 3, 21, 16, 53
+    h = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    W = jnp.asarray(rng.normal(size=(D, V)), jnp.float32)
+    labels = jnp.asarray(
+        np.concatenate([rng.integers(0, V, (B, T - 1)),
+                        np.full((B, 1), V + 7)], axis=1))  # one OOV label
+
+    def head(hc):
+        return (hc @ W).astype(jnp.float32)
+
+    full = logprobs_from_logits(head(h), labels)
+    for chunk in (4, 7, 16, 64):
+        got = jax.jit(
+            lambda h, l: chunked_label_logprobs(head, h, l, chunk=chunk)
+        )(h, labels)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                                   atol=1e-5)

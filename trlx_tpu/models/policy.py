@@ -152,12 +152,10 @@ class HydraPolicy:
         )
         return h, mask_bias, positions
 
-    def _branch_logits(
-        self, branch: Params, embed: Params, h, mask_bias, positions
-    ):
-        """Run a top branch; returns (logits, post-ln_f hidden). The value
-        head reads the post-ln_f hidden, matching the reference's v_head on
-        the transformer output (reference: ppo_models.py:62-104)."""
+    def _branch_hidden(self, branch: Params, h, mask_bias, positions):
+        """Run a top branch's blocks + final layernorm; returns the
+        post-ln_f hidden (what both the lm head and the value head read —
+        reference: ppo_models.py:62-104)."""
         h = apply_blocks(
             branch["blocks"],
             self.spec,
@@ -167,11 +165,19 @@ class HydraPolicy:
             remat=self.remat,
             attention_fn=self._attn(),
         )
-        h_normed = layer_norm(branch["ln_f"], h, self.spec.layer_norm_epsilon)
+        return layer_norm(branch["ln_f"], h, self.spec.layer_norm_epsilon)
+
+    def branch_head_fn(self, branch: Params, embed: Params):
+        """h_normed [B, T, D] -> float32 logits [B, T, V] for a branch —
+        the head callback chunked scoring feeds T-slices through
+        (trlx_tpu.ops.losses.chunked_label_logprobs)."""
         head_params = dict(embed)
         if "lm_head" in branch:
             head_params["lm_head"] = branch["lm_head"]
-        return project_logits(head_params, self.spec, h_normed), h_normed
+        return lambda h_normed: project_logits(
+            head_params, self.spec, h_normed
+        )
+
 
     def forward(
         self,
@@ -185,20 +191,46 @@ class HydraPolicy:
         logits/ref_logits: [B, T, V] float32; values: [B, T] float32.
         The trunk (embeddings + frozen bottom blocks) runs exactly once.
         """
-        h, mask_bias, positions = self._trunk(params, tokens, attention_mask)
-        embed = params["frozen_base"]["embed"]
-        logits, h_top = self._branch_logits(
-            params["trainable"], embed, h, mask_bias, positions
+        h_top, h_ref, values = self.forward_hidden(
+            params, tokens, attention_mask, with_ref
         )
-        values = head_apply(params["trainable"]["v_head"], h_top).squeeze(-1)
+        embed = params["frozen_base"]["embed"]
+        logits = self.branch_head_fn(params["trainable"], embed)(h_top)
         ref_logits = None
         if with_ref:
-            ref_in = jax.lax.stop_gradient(h)
-            ref_logits, _ = self._branch_logits(
-                params["ref"], embed, ref_in, mask_bias, positions
+            ref_logits = jax.lax.stop_gradient(
+                self.branch_head_fn(params["ref"], embed)(h_ref)
             )
-            ref_logits = jax.lax.stop_gradient(ref_logits)
         return logits, ref_logits, values
+
+    def forward_hidden(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+        with_ref: bool = True,
+    ):
+        """Trunk + both top branches WITHOUT the lm-head projection:
+        (h_policy_normed [B, T, D], h_ref_normed | None, values [B, T]).
+
+        The scoring path pairs this with chunked_label_logprobs so the
+        [B, T, V] logits tensors (the rollout program's memory peak) are
+        never materialized; use `branch_head_fn` for the matching head
+        callbacks."""
+        h, mask_bias, positions = self._trunk(params, tokens, attention_mask)
+        h_top = self._branch_hidden(
+            params["trainable"], h, mask_bias, positions
+        )
+        values = head_apply(params["trainable"]["v_head"], h_top).squeeze(-1)
+        h_ref = None
+        if with_ref:
+            h_ref = jax.lax.stop_gradient(
+                self._branch_hidden(
+                    params["ref"], jax.lax.stop_gradient(h), mask_bias,
+                    positions,
+                )
+            )
+        return h_top, h_ref, values
 
     # -- decode support -----------------------------------------------------
 

@@ -61,6 +61,39 @@ def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarra
     ]
 
 
+def chunked_label_logprobs(
+    head_fn, h: jnp.ndarray, labels: jnp.ndarray, chunk: int = 16
+) -> jnp.ndarray:
+    """Per-position logprobs of `labels` from hidden states WITHOUT ever
+    materializing the [B, T, V] logits tensor.
+
+    h: [B, T, D] (already final-layernormed); labels: [B, T];
+    head_fn(h_chunk [B, c, D]) -> float32 logits [B, c, V].
+
+    The full-logits path costs O(B*T*V) live memory per branch — 1.34 GB
+    at [128, 52, 50257] f32, 2.7 GB with the hydra's reference branch —
+    inside the fused rollout program where it sets the peak. Scanning
+    T-chunks bounds that to O(B*chunk*V) (~0.4 GB at chunk=16) at the cost
+    of re-reading the head weights once per chunk. Scoring-only (no
+    gradient path needs this; the train loss differentiates through its
+    own full forward)."""
+    B, T, D = h.shape
+    pad = (-T) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+    n = h.shape[1] // chunk
+    h_chunks = h.reshape(B, n, chunk, D).transpose(1, 0, 2, 3)
+    l_chunks = labels.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    def body(_, xs):
+        h_c, l_c = xs
+        return None, logprobs_from_logits(head_fn(h_c), l_c)
+
+    _, out = jax.lax.scan(body, None, (h_chunks, l_chunks))
+    return out.transpose(1, 0, 2).reshape(B, n * chunk)[:, :T]
+
+
 # [T, T] GAE weight matrices cost T^2 memory; beyond this the sequential
 # scan wins (long-context PPO already spends its time in attention anyway)
 _GAE_MATMUL_MAX_T = 2048

@@ -34,6 +34,7 @@ from trlx_tpu.models.generation import GenerationConfig, generate
 from trlx_tpu.models.hf_import import hydra_params_from_trunk
 from trlx_tpu.models.policy import HydraPolicy
 from trlx_tpu.ops.losses import (
+    chunked_label_logprobs,
     gae_advantages,
     kl_penalty_rewards,
     logprobs_from_logits,
@@ -187,16 +188,27 @@ class JaxPPOTrainer(BaseRLTrainer):
             runs — keeps this dispatchable before the reward exists, so one
             host round trip covers generation + scoring).
 
-            Replaces the reference's two forward passes + host KL math
-            (ppo_orchestrator.py:70-98)."""
-            logits, ref_logits, values = policy.forward(
+            Logprobs are computed CHUNKED from the branch hidden states
+            (trlx_tpu.ops.losses.chunked_label_logprobs): the [B, T, V]
+            logits tensors of the policy AND reference branch — 2.7 GB at
+            gpt2-124M [128, 52], the fused rollout program's memory peak —
+            are never materialized. Replaces the reference's two forward
+            passes + host KL math (ppo_orchestrator.py:70-98)."""
+            h_top, h_ref, values = policy.forward_hidden(
                 params, sequences, attention_mask, with_ref=True
             )
             P = input_size  # static
             response = sequences[:, P:]
             window = slice(P - 1, sequences.shape[1] - 1)
-            logprobs = logprobs_from_logits(logits[:, window], response)
-            ref_logprobs = logprobs_from_logits(ref_logits[:, window], response)
+            embed = params["frozen_base"]["embed"]
+            logprobs = chunked_label_logprobs(
+                policy.branch_head_fn(params["trainable"], embed),
+                h_top[:, window], response,
+            )
+            ref_logprobs = chunked_label_logprobs(
+                policy.branch_head_fn(params["ref"], embed),
+                h_ref[:, window], response,
+            )
             vals = values[:, window]
             rewards, seq_kl = kl_penalty_rewards(
                 logprobs, ref_logprobs,
