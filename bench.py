@@ -544,7 +544,11 @@ def bench_quality(cycles=50):
         reward_fn, _prompts = mod.online_pieces(qconfig)
         real = True
         log("quality leg: using local-cache gpt2-imdb/distilbert reward")
-        orch.reward_fn = reward_fn  # same orchestrator machinery
+        # rebind BOTH references: the orchestrator scores rollouts through
+        # orch.reward_fn, but trainer.evaluate() scores through
+        # trainer.reward_fn (bound at set_orchestrator time)
+        orch.reward_fn = reward_fn
+        trainer.reward_fn = reward_fn
     except Exception:
         pass  # synthetic reward already wired
 

@@ -77,6 +77,30 @@ def test_load_reference_style_yaml(tmp_path):
     assert d["cliprange"] == 0.2 and d["n_ctx"] == 512
 
 
+def test_to_dict_collision_safe(tmp_path):
+    """A field name shared by two sections must come out section-prefixed,
+    not silently last-wins (a method field shadowing a train field would
+    corrupt logged hyperparameters)."""
+    from dataclasses import dataclass
+
+    import yaml
+
+    @register_method("collidetest")
+    @dataclass
+    class CollideConfig(MethodConfig):
+        epochs: int = 7  # collides with train.epochs
+
+    raw = yaml.safe_load(PPO_YAML)
+    raw["method"] = {"name": "collidetest", "epochs": 7}
+    cfg = TRLConfig.from_dict(raw)
+    d = cfg.to_dict()
+    assert "epochs" not in d
+    assert d["train.epochs"] == 10
+    assert d["method.epochs"] == 7
+    # unique fields stay bare
+    assert d["n_ctx"] == 512 and d["model_path"] == "lvwerra/gpt2-imdb"
+
+
 def test_method_registry_case_insensitive():
     assert get_method("PPOConfig") is PPOConfig
     assert get_method("ilqlconfig") is ILQLConfig

@@ -206,3 +206,58 @@ def test_memory_fit_check_gptj_geometry(monkeypatch):
     # and the env override really overrides
     monkeypatch.setenv("TRLX_TPU_SKIP_MEMCHECK", "1")
     trainer._check_memory_fit(gptj, jnp.float32)
+
+def test_ilql_memory_fit_check_fires(monkeypatch):
+    """The ILQL trainer must run the pre-flight HBM check too: a gpt-j-6B
+    ILQL config (fp32 everything + [d, V] Q/target heads) fails fast on a
+    16 GB device instead of OOMing mid-init."""
+    import jax
+
+    from tests.test_ilql import rw_config
+    from trlx_tpu.utils.loading import get_model
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 16 * 2**30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    config = rw_config(n_nodes=21)
+    config.model.model_spec = {
+        "arch": "gptj", "vocab_size": 50400, "n_layer": 28, "n_head": 16,
+        "d_model": 4096, "n_positions": 2048, "rotary_dim": 64,
+        "tie_lm_head": False,
+    }
+    with pytest.raises(ValueError, match="HBM"):
+        get_model(config.model.model_type)(config)
+
+
+def test_debug_nans_no_cross_trainer_leak():
+    """A trainer with debug_nans=true must not leak jax_debug_nans into a
+    later trainer constructed with debug_nans=false — but an EXTERNALLY
+    enabled flag must survive framework trainers that didn't ask for it."""
+    import jax
+
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.utils.loading import get_model
+
+    assert not jax.config.jax_debug_nans
+    try:
+        cfg = make_config(total_steps=2)
+        cfg.train.debug_nans = True
+        get_model(cfg.model.model_type)(cfg)
+        assert jax.config.jax_debug_nans
+
+        cfg2 = make_config(total_steps=2)
+        get_model(cfg2.model.model_type)(cfg2)
+        assert not jax.config.jax_debug_nans, (
+            "framework-set debug_nans leaked into the next trainer"
+        )
+
+        # externally-set flag is preserved through a default trainer
+        jax.config.update("jax_debug_nans", True)
+        get_model(cfg2.model.model_type)(cfg2)
+        assert jax.config.jax_debug_nans, (
+            "externally-set debug_nans was clobbered"
+        )
+    finally:
+        jax.config.update("jax_debug_nans", False)

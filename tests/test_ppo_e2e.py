@@ -288,3 +288,60 @@ def test_make_experience_crosses_host_boundary_twice_per_chunk(monkeypatch):
             f"per-chunk fetch grew to {fetched} bytes - per-token arrays "
             f"are leaking into the host round trip"
         )
+
+def test_make_experience_rounds_up_and_warns():
+    """A num_rollouts that is not a chunk_size multiple is rounded UP (whole
+    fused chunks only) with a warning, and the info dict reports the count
+    actually produced — never fewer than asked, never silently more."""
+    import pytest
+
+    config, trainer, pipeline, orch = build()
+    trainer.store.clear_history()
+    with pytest.warns(UserWarning, match="not a multiple"):
+        info = orch.make_experience(8)  # chunk_size is 16
+    assert info["rollouts"] == 16
+    assert len(trainer.store) == 16
+
+    trainer.store.clear_history()
+    with pytest.warns(UserWarning, match="not a multiple"):
+        info = orch.make_experience(24)
+    assert info["rollouts"] == 32
+    assert len(trainer.store) == 32
+
+    with pytest.raises(ValueError, match="positive"):
+        orch.make_experience(0)
+
+
+def test_termination_either_bound():
+    """Training stops when EITHER total_steps or epochs is reached — a
+    deliberate, documented divergence from the reference, which keeps
+    training until BOTH are exceeded (reference
+    accelerate_ppo_model.py:174-177) and thereby overruns total_steps
+    whenever epochs is the larger bound."""
+    def run(total_steps, epochs):
+        config = make_config(total_steps=total_steps, epochs=epochs,
+                             ppo_epochs=2, batch_size=16,
+                             num_rollouts=32, chunk_size=16)
+        trainer = get_model(config.model.model_type)(config)
+        trainer.tokenizer = ByteTokenizer()
+        pipeline = get_pipeline(config.train.pipeline)(
+            PROMPTS, trainer.tokenizer, config
+        )
+        orch = get_orchestrator(config.train.orchestrator)(
+            trainer, pipeline, reward_fn=reward_fn, chunk_size=16
+        )
+        orch.make_experience(config.method.num_rollouts)
+        trainer.learn(log_fn=lambda s: None)
+        return trainer
+
+    # total_steps binds first: 32 rollouts / 16 batch * 2 ppo_epochs
+    # = 4 steps/epoch; stops during the first pass (the post-loop epoch
+    # increment leaves the counter at 1), not after 100 epochs
+    trainer = run(total_steps=4, epochs=100)
+    assert trainer.iter_count == 4
+    assert trainer.epoch == 1
+
+    # epochs binds first: one pass over the store, total_steps untouched
+    trainer = run(total_steps=10**9, epochs=1)
+    assert trainer.iter_count == 4
+    assert trainer.epoch == 1

@@ -241,7 +241,24 @@ class TRLConfig:
         )
 
     def to_dict(self) -> Dict[str, Any]:
-        data = dict(self.model.__dict__)
-        data.update(self.train.__dict__)
-        data.update(self.method.__dict__)
+        """Flat merged view of all three sections (the shape trackers log).
+
+        Collision-safe: a field name appearing in more than one section is
+        emitted once per section as ``<section>.<name>`` instead of letting
+        the later section silently overwrite the earlier one (a method
+        field shadowing a train field would otherwise corrupt logged
+        hyperparameters)."""
+        sections = {
+            "model": self.model.__dict__,
+            "train": self.train.__dict__,
+            "method": self.method.__dict__,
+        }
+        counts: Dict[str, int] = {}
+        for fields in sections.values():
+            for k in fields:
+                counts[k] = counts.get(k, 0) + 1
+        data: Dict[str, Any] = {}
+        for section, fields in sections.items():
+            for k, v in fields.items():
+                data[k if counts[k] == 1 else f"{section}.{k}"] = v
         return data

@@ -98,10 +98,29 @@ class PPOOrchestrator(Orchestrator):
         return broadcast_host_floats(self.reward_fn(texts))
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
-        """Fill the trainer's rollout store with `num_rollouts` scored
-        rollouts (parity: reference ppo_orchestrator.py:51-120)."""
+        """Fill the trainer's rollout store with at least `num_rollouts`
+        scored rollouts (parity: reference ppo_orchestrator.py:51-120).
+
+        Rollouts are produced in whole chunks (one fused device program
+        each), so `num_rollouts` is rounded UP to a multiple of
+        `chunk_size` — with a warning — and the returned info reports the
+        count actually produced."""
+        import warnings
+
+        if num_rollouts <= 0:
+            raise ValueError(
+                f"make_experience: num_rollouts must be positive, got "
+                f"{num_rollouts}"
+            )
         trainer = self.rl_model
-        n_chunks = max(num_rollouts // self.chunk_size, 1)
+        n_chunks = -(-num_rollouts // self.chunk_size)
+        if n_chunks * self.chunk_size != num_rollouts:
+            warnings.warn(
+                f"make_experience: num_rollouts={num_rollouts} is not a "
+                f"multiple of chunk_size={self.chunk_size}; producing "
+                f"{n_chunks * self.chunk_size} rollouts",
+                stacklevel=2,
+            )
         bank_tokens, bank_mask = self._prompt_bank()
 
         # dispatch the fused rollout for chunk 0; inside the loop, dispatch
@@ -175,7 +194,9 @@ class PPOOrchestrator(Orchestrator):
 
         # adaptive KL update from measured KL (parity: reference
         # accelerate_ppo_model.py:205 -> 130-135)
-        trainer.post_rollout_kl_update(float(np.mean(all_kls)), num_rollouts)
+        trainer.post_rollout_kl_update(
+            float(np.mean(all_kls)), n_chunks * self.chunk_size
+        )
         return {
             "rollouts": n_chunks * self.chunk_size,
             "mean_score": float(np.concatenate(all_scores).mean()),

@@ -14,6 +14,12 @@ from typing import Callable, Dict
 from trlx_tpu.utils.registry import BuiltinLoader, make_register
 
 _TRAINERS: Dict[str, type] = {}
+
+# Whether THIS framework enabled jax_debug_nans (vs the user setting
+# JAX_DEBUG_NANS externally). Lets a later trainer constructed with
+# debug_nans=false undo a flag a previous trainer in the same process set,
+# without ever clobbering an externally-enabled debug flag.
+_framework_set_debug_nans = False
 _load_builtins = BuiltinLoader(
     ("trlx_tpu.trainers.ppo_trainer", "trlx_tpu.trainers.ilql_trainer")
 )
@@ -40,11 +46,20 @@ class BaseRLTrainer:
         self.train_mode = train_mode
         self.store = None
         # opt-in only: an unset config flag must not clobber a debug flag
-        # the user enabled externally (JAX_DEBUG_NANS / jax.config)
+        # the user enabled externally (JAX_DEBUG_NANS / jax.config) — but a
+        # flag the FRAMEWORK set for an earlier trainer must not leak into
+        # later trainers constructed with debug_nans=false
+        global _framework_set_debug_nans
         if getattr(config.train, "debug_nans", False):
             import jax
 
             jax.config.update("jax_debug_nans", True)
+            _framework_set_debug_nans = True
+        elif _framework_set_debug_nans:
+            import jax
+
+            jax.config.update("jax_debug_nans", False)
+            _framework_set_debug_nans = False
         # multi-host bootstrap first (no-op single-process), so the mesh
         # sees the pod's global device list
         initialize_runtime()
@@ -136,9 +151,11 @@ class BaseRLTrainer:
             from trlx_tpu.ops.pallas_attention import make_pallas_attention_fn
 
             # gate per-call on the ACTUAL traced length, not just the config
-            # length: ILQL pads each batch to its own max, so auto-enabled
-            # runs can still see short batches below the kernel's measured
-            # parity point — those take the dense fallback inside the fn.
+            # length: ILQL collates the whole store once padded to the
+            # store-global max, and eval/sample calls trace their own
+            # lengths — auto-enabled runs can still see sequences below the
+            # kernel's measured parity point; those take the dense fallback
+            # inside the fn.
             # An explicit model.fused_attention=True keeps the kernel's own
             # lower floor (the user asked for the kernel).
             forced = self.config.model.fused_attention is not None
@@ -148,14 +165,26 @@ class BaseRLTrainer:
             )
         return None
 
-    def _check_memory_fit(self, spec, frozen_dtype) -> None:
+    def _check_memory_fit(self, spec, frozen_dtype, ref_branch=True,
+                          extra_trainable=0, extra_frozen=0) -> None:
         """Fail BEFORE allocation with an actionable message when the model
         state clearly cannot fit the per-device HBM budget (a 24 GB fp32
         gpt-j-6B OOMing mid-init is far harder to diagnose). Estimates
         params (frozen in frozen_dtype, trainable+ref tops, fp32 adam
         moments for the trainable top), divided by the mesh's parameter
-        sharding extent (fsdp * tp). Skipped when the runtime exposes no
-        bytes_limit or TRLX_TPU_SKIP_MEMCHECK=1."""
+        sharding extent (fsdp * tp).
+
+        The estimate is a deliberate LOWER bound: dividing by fsdp*tp
+        assumes every tensor shards over both axes, but the sharding rules
+        replicate small tensors (layernorms, biases, v_head) — a config
+        that passes can still OOM near the boundary; one that fails
+        definitely would have. Skipped when the runtime exposes no
+        bytes_limit or TRLX_TPU_SKIP_MEMCHECK=1.
+
+        `ref_branch=False` drops the frozen reference-branch term (ILQL has
+        no ref copy); `extra_trainable` / `extra_frozen` add
+        parameter-count terms for method-specific heads (ILQL's Q/V heads
+        and frozen target-Q copies)."""
         import os
 
         if os.environ.get("TRLX_TPU_SKIP_MEMCHECK"):
@@ -183,8 +212,9 @@ class BaseRLTrainer:
         frozen_sz = np.dtype(frozen_dtype).itemsize
         est = (
             ((L - k) * per_layer + embed) * frozen_sz   # frozen trunk
-            + (k * per_layer + lm_head) * frozen_sz     # ref branch
-            + (k * per_layer + lm_head) * 4 * 3         # trainable + 2 adam
+            + (k * per_layer + lm_head) * frozen_sz * (1 if ref_branch else 0)
+            + (k * per_layer + lm_head + extra_trainable) * 4 * 3  # + 2 adam
+            + extra_frozen * frozen_sz
         )
         shards = 1
         if self.mesh is not None:

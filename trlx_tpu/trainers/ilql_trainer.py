@@ -54,6 +54,17 @@ class JaxILQLTrainer(BaseRLTrainer):
         rng = jax.random.PRNGKey(config.train.seed)
         self._rng, init_rng = jax.random.split(rng)
         spec, trunk = self._load_or_spec(config)
+        # pre-flight HBM fit (same fail-fast as PPO): no ref branch, but
+        # the Q/V heads are trainable [d, V] tensors with adam moments and
+        # the target-Q copies are frozen [d, V] tensors — at 6B scale each
+        # is ~0.8 GB and must be counted
+        n_q = 2 if m.two_qs else 1
+        head_params = n_q * spec.d_model * spec.vocab_size + spec.d_model
+        self._check_memory_fit(
+            spec, jnp.float32, ref_branch=False,
+            extra_trainable=head_params,
+            extra_frozen=n_q * spec.d_model * spec.vocab_size,
+        )
         self.net = ILQLNet(
             spec=spec,
             num_layers_unfrozen=config.model.num_layers_unfrozen,
@@ -339,7 +350,10 @@ class JaxILQLTrainer(BaseRLTrainer):
 
         # collate + upload the WHOLE offline dataset once (rows pad to the
         # store-global max length, so per-batch shapes are identical);
-        # every train step then sends only a [batch] index array. Rows are
+        # every train step then sends only a [batch] index array. Tradeoff:
+        # one long outlier row inflates every step's compute to its length
+        # — with uniform offline data (the norm) that's free, and it buys
+        # ONE traced shape + zero per-batch uploads. Rows are
         # padded (repeat-last) to the mesh's dp*fsdp multiple for
         # shard_batch; indices only ever address the n real rows. Datasets
         # too large to sit in HBM next to params+opt keep the per-batch

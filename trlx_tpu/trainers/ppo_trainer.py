@@ -449,6 +449,15 @@ class JaxPPOTrainer(BaseRLTrainer):
         rollout store, `ppo_epochs` passes per batch, KL-coef update +
         periodic eval between batches, fresh experience each outer epoch.
 
+        Termination DELIBERATELY diverges from the reference: training
+        stops when EITHER `total_steps` or `epochs` is reached. The
+        reference keeps going until BOTH are exceeded
+        (accelerate_ppo_model.py:174-177), which overruns `total_steps`
+        whenever `epochs` is the larger bound — with a cosine LR schedule
+        annealed over `total_steps`, those overrun steps train at the
+        floor LR. Tested in
+        tests/test_ppo_e2e.py::test_termination_either_bound.
+
         Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace of
         the loop (trlx_tpu.utils.profiling)."""
         from trlx_tpu.utils.profiling import annotate, maybe_trace
