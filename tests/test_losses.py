@@ -77,7 +77,8 @@ def test_whiten():
     x = rng.normal(loc=3.0, scale=2.0, size=(4, 9)).astype(np.float32)
     w = np.asarray(whiten(jnp.asarray(x)))
     np.testing.assert_allclose(w.mean(), 0.0, atol=1e-5)
-    np.testing.assert_allclose(w.std(), 1.0, atol=1e-3)
+    # torch.var parity: unbiased (n-1) variance normalizes to 1
+    np.testing.assert_allclose(w.std(ddof=1), 1.0, atol=1e-3)
     w2 = np.asarray(whiten(jnp.asarray(x), shift_mean=False))
     np.testing.assert_allclose(w2.mean(), x.mean(), atol=1e-4)
 

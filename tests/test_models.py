@@ -259,5 +259,14 @@ def test_debug_nans_no_cross_trainer_leak():
         assert jax.config.jax_debug_nans, (
             "externally-set debug_nans was clobbered"
         )
+
+        # external enable + config enable: the framework must NOT claim
+        # ownership of a flag the user already set, so a later default
+        # trainer leaves it on
+        get_model(cfg.model.model_type)(cfg)  # debug_nans=True config
+        get_model(cfg2.model.model_type)(cfg2)
+        assert jax.config.jax_debug_nans, (
+            "external flag disabled after a config-enabled trainer"
+        )
     finally:
         jax.config.update("jax_debug_nans", False)

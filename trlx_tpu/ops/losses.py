@@ -31,10 +31,20 @@ def whiten(
     shift_mean: bool = True,
     mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Normalize to zero mean / unit variance
-    (parity: reference trlx/utils/modeling.py:5-11)."""
+    """Normalize to zero mean / unit variance using the UNBIASED (n-1)
+    variance — exact parity with the reference's `torch.var`
+    (reference trlx/utils/modeling.py:5-11; torch.var defaults to the
+    Bessel-corrected estimator). The masked form applies the same n-1
+    correction over real elements."""
     mean = masked_mean(x, mask)
-    var = masked_mean((x - mean) ** 2, mask)
+    if mask is None:
+        n = jnp.asarray(x.size, x.dtype)
+        sq = ((x - mean) ** 2).sum()
+    else:
+        m = mask.astype(x.dtype)
+        n = m.sum()
+        sq = (((x - mean) ** 2) * m).sum()
+    var = sq / jnp.maximum(n - 1.0, 1.0)
     out = (x - mean) * jax.lax.rsqrt(var + 1e-8)
     if not shift_mean:
         out = out + mean

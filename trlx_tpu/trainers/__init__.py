@@ -53,8 +53,12 @@ class BaseRLTrainer:
         if getattr(config.train, "debug_nans", False):
             import jax
 
-            jax.config.update("jax_debug_nans", True)
-            _framework_set_debug_nans = True
+            # only claim ownership when WE flipped it: if the user enabled
+            # the flag externally before this trainer, a later default
+            # trainer must not turn it off
+            if not jax.config.jax_debug_nans:
+                jax.config.update("jax_debug_nans", True)
+                _framework_set_debug_nans = True
         elif _framework_set_debug_nans:
             import jax
 
@@ -223,13 +227,20 @@ class BaseRLTrainer:
             )
         est //= shards
         if est > int(limit * 1.05):
+            # param_dtype only helps methods with a frozen-dtype storage
+            # path (the PPO hydra); suggesting it for ILQL would send the
+            # user down a dead end
+            dtype_opt = (
+                "set model.param_dtype: bfloat16 (frozen trunk + ref "
+                "branch storage; trainable/optimizer stay fp32), "
+                if ref_branch else ""
+            )
             raise ValueError(
                 f"model state needs ~{est / 2**30:.1f} GB/device but the "
-                f"device reports {limit / 2**30:.1f} GB HBM. Options: set "
-                f"model.param_dtype: bfloat16 (frozen trunk + ref branch "
-                f"storage; trainable/optimizer stay fp32), lower "
-                f"num_layers_unfrozen, shard over a mesh with fsdp/tp, or "
-                f"set TRLX_TPU_SKIP_MEMCHECK=1 to try anyway."
+                f"device reports {limit / 2**30:.1f} GB HBM. Options: "
+                f"{dtype_opt}lower num_layers_unfrozen, shard over a mesh "
+                f"with fsdp/tp, or set TRLX_TPU_SKIP_MEMCHECK=1 to try "
+                f"anyway."
             )
 
     def push_to_store(self, data) -> None:
