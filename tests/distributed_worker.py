@@ -1,0 +1,111 @@
+"""Worker process for the two-process jax.distributed test.
+
+Spawned twice by tests/test_parallel.py::test_two_process_distributed_cpu
+(`python tests/distributed_worker.py <coordinator> <rank>`). Each process
+brings up the multi-host runtime through `initialize_runtime`'s explicit
+path (the layer the reference validated with two `accelerate launch`
+nodes — reference trlx/model/accelerate_base_model.py:54-55), then runs a
+tiny PPO chunk + train step over a dp mesh SPANNING both processes and
+checks the framework's multi-host invariants:
+
+- `process_count()` / `is_main_process()` reflect the 2-process rig;
+- `broadcast_host_floats` overrides rank 1's deliberately-divergent host
+  rewards with rank 0's (replicated-loading SPMD requires bit-identical
+  host inputs on every process — sharding.shard_batch's contract);
+- after make_experience + learn, the trainable parameters are BIT-identical
+  across processes (allgathered digests match), i.e. divergent host state
+  never forked the replicas.
+
+Prints "DIST OK <rank>" on success; any assertion kills the process and
+fails the spawning test.
+"""
+
+import hashlib
+import os
+import sys
+
+
+def main():
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import numpy as np
+
+    from trlx_tpu.parallel.runtime import (
+        broadcast_host_floats,
+        initialize_runtime,
+        is_main_process,
+        process_count,
+    )
+
+    initialize_runtime(coordinator, num_processes=2, process_id=rank)
+    assert process_count() == 2, f"process_count {process_count()}"
+    assert is_main_process() == (rank == 0)
+    assert len(jax.devices()) == 8, f"global devices {len(jax.devices())}"
+
+    # rank 1 computes garbage host rewards; both must end up with rank 0's
+    vals = [1.5, -2.25, 3.0] if rank == 0 else [9.0, 9.0, 9.0]
+    out = broadcast_host_floats(vals)
+    np.testing.assert_allclose(out, [1.5, -2.25, 3.0])
+
+    # --- tiny PPO chunk over a mesh spanning both processes ------------- #
+    from tests.test_ppo_e2e import PROMPTS, make_config
+    from trlx_tpu.utils.loading import get_model, get_orchestrator, get_pipeline
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = make_config(
+        total_steps=2, epochs=1, ppo_epochs=1, num_rollouts=16,
+        chunk_size=16, batch_size=16,
+    )
+    config.train.mesh = {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+
+    def rank_divergent_reward(texts):
+        # deterministic base; rank 1 adds garbage that broadcast must erase
+        base = [float(len(t) % 5) / 5.0 for t in texts]
+        if rank == 1:
+            return [b + 100.0 for b in base]
+        return base
+
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=rank_divergent_reward,
+        chunk_size=config.method.chunk_size,
+    )
+    info = orch.make_experience(config.method.num_rollouts)
+    assert info["mean_score"] < 50.0, (
+        f"rank-divergent rewards leaked past broadcast: {info['mean_score']}"
+    )
+    trainer.learn(log_fn=lambda s: None)
+    # 16 rollouts / 16 batch * 1 ppo_epoch * 1 epoch = 1 optimizer step
+    assert trainer.iter_count == 1, trainer.iter_count
+
+    # --- params bit-identical across processes -------------------------- #
+    from jax.experimental import multihost_utils
+
+    leaves = jax.tree_util.tree_leaves(trainer.params["trainable"])
+    blob = b"".join(
+        np.ascontiguousarray(np.asarray(x)).tobytes() for x in leaves
+    )
+    digest = np.frombuffer(
+        hashlib.sha256(blob).digest()[:8], dtype=np.uint64
+    )
+    digests = np.asarray(multihost_utils.process_allgather(digest))
+    assert (digests == digests[0]).all(), (
+        f"params diverged across processes: {digests}"
+    )
+    print(f"DIST OK {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -376,3 +376,58 @@ def test_broadcast_host_floats_uses_process0_when_multihost(monkeypatch):
     np.testing.assert_array_equal(called["arr"], [1.0, 2.0])
     np.testing.assert_array_equal(out, [1.0, 2.0])
     assert out.dtype == np.float32
+
+def test_two_process_distributed_cpu(tmp_path):
+    """Bring up jax.distributed across TWO real processes (the multi-host
+    layer everything else only exercises single-process): explicit
+    initialize_runtime, a dp mesh spanning both, broadcast_host_floats
+    overriding rank-1's divergent rewards, and bit-identical trained params
+    (see tests/distributed_worker.py for the per-process assertions)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    root = Path(__file__).resolve().parent.parent
+    worker = root / "tests" / "distributed_worker.py"
+
+    env = dict(os.environ)
+    # the worker pins its own JAX env before importing jax
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    # sys.path[0] for a script is its own directory, not the cwd
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(root), env.get("PYTHONPATH", "")) if p
+    )
+
+    # write child output to files, not pipes: a verbose failing rank can
+    # fill a pipe buffer and deadlock the sibling in a collective while
+    # the parent blocks on the other child
+    logs = [tmp_path / f"rank{rank}.log" for rank in (0, 1)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), coordinator, str(rank)],
+            cwd=root, env=env,
+            stdout=open(log, "w"), stderr=subprocess.STDOUT,
+        )
+        for rank, log in zip((0, 1), logs)
+    ]
+    try:
+        for p in procs:
+            p.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+    outs = [log.read_text() for log in logs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {rank} failed (rc={p.returncode}):\n{out[-4000:]}"
+        )
+        assert f"DIST OK {rank}" in out, f"rank {rank} output:\n{out[-2000:]}"
