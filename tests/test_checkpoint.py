@@ -206,3 +206,69 @@ def test_save_restore_preserves_mixed_param_dtypes(tmp_path):
         for a, b in zip(jax.tree_util.tree_leaves(trainer.params[part]),
                         leaves):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+def test_sigterm_preemption_saves_and_resumes(tmp_path):
+    """SIGTERM mid-learn() must checkpoint at the next step boundary and
+    return cleanly (no death, handler restored); a fresh trainer with
+    resume_from must restore that checkpoint bit-exact and finish the run
+    (the preemptible-VM / node-drain story — trlx_tpu.utils.preemption)."""
+    import os
+    import signal
+
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    config, trainer, orch = _built_trainer(tmp_path)
+    config.train.epochs = 100
+    config.train.total_steps = 8
+    config.train.checkpoint_interval = 10**9  # only the preemption save
+    config.train.log_interval = 1
+    orch.make_experience(config.method.num_rollouts)
+
+    logs = []
+    sent = []
+
+    def log_fn(stats):
+        logs.append(stats)
+        # "kill" the run right after the 2nd optimizer step's log line
+        # (one step per epoch here, so a rollout refresh sits in between)
+        if stats.get("iter") == 2 and "loss" in stats and not sent:
+            sent.append(1)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    trainer.learn(log_fn=log_fn)  # returns instead of dying
+    assert sent, "kill point never reached"
+    assert trainer.iter_count == 2
+    assert any(s.get("preempted") for s in logs)
+    # the trap is scoped to learn(): previous handler back in place
+    assert signal.getsignal(signal.SIGTERM) is prev_handler
+
+    saved = _leaves(trainer.params["trainable"])
+
+    # fresh "process" (different seed) resumes from the preemption save
+    config2, resumed, orch2 = _built_trainer(tmp_path, seed=9)
+    config2.train.resume_from = config.train.checkpoint_dir
+    config2.train.epochs = 100
+    config2.train.total_steps = 8
+    config2.train.checkpoint_interval = 10**9
+    # _built_trainer constructed before resume_from was set; restore now
+    # (a real run sets resume_from in the config and restores at
+    # construction — test_resume_from_kill_and_continue covers that)
+    assert resumed.maybe_resume()
+    for a, b in zip(saved, _leaves(resumed.params["trainable"])):
+        np.testing.assert_array_equal(a, b)
+
+    orch2.make_experience(config2.method.num_rollouts)
+    resumed.learn(log_fn=lambda s: None)
+    assert resumed.iter_count == 8
+
+
+def test_preemption_guard_disabled_by_config(tmp_path):
+    """train.save_on_preemption=false keeps the default SIGTERM behavior:
+    the guard never installs a handler during learn()."""
+    import signal
+
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False) as guard:
+        assert signal.getsignal(signal.SIGTERM) is prev
+        assert not guard.requested

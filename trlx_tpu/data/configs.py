@@ -211,6 +211,10 @@ class TrainConfig:
     # restore components from this checkpoint directory at the start of the
     # first learn() call (kill-and-continue resume); "" disables
     resume_from: str = ""
+    # trap SIGTERM during learn(): checkpoint at the next step boundary and
+    # return cleanly (preemptible VMs / node drains), resumable via
+    # resume_from (trlx_tpu.utils.preemption)
+    save_on_preemption: bool = True
     debug_nans: bool = False
 
     @classmethod

@@ -326,6 +326,19 @@ class BaseRLTrainer:
         )
         self.set_components(restored)
 
+    def _preempt(self, log_fn, guard, just_saved: bool = False) -> bool:
+        """Checkpoint + True when a SIGTERM arrived on ANY process
+        (trlx_tpu.utils.preemption; resume via train.resume_from picks up
+        exactly here). `just_saved`: an interval checkpoint fired at this
+        same step boundary — skip the redundant second Orbax write (the
+        eviction grace period is short)."""
+        if guard is None or not guard.poll():
+            return False
+        if not just_saved:
+            self.save()
+        log_fn({"iter": self.iter_count, "preempted": 1.0})
+        return True
+
     def maybe_resume(self) -> bool:
         """Restore from config.train.resume_from once, at trainer
         construction — BEFORE any make_experience/evaluate the caller runs,

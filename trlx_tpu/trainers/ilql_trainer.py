@@ -328,14 +328,19 @@ class JaxILQLTrainer(BaseRLTrainer):
 
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
         """Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace
-        of the loop (trlx_tpu.utils.profiling)."""
+        of the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
+        checkpoints at the next step boundary and returns cleanly
+        (train.save_on_preemption, trlx_tpu.utils.preemption)."""
+        from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import maybe_trace
 
         self.maybe_resume()  # no-op when already restored at construction
-        with maybe_trace():
-            self._learn_loop(log_fn, save_fn, eval_fn)
+        enabled = getattr(self.config.train, "save_on_preemption", True)
+        with maybe_trace(), PreemptionGuard(enabled) as guard:
+            self._learn_loop(log_fn, save_fn, eval_fn, guard)
 
-    def _learn_loop(self, log_fn=None, save_fn=None, eval_fn=None):
+    def _learn_loop(self, log_fn=None, save_fn=None, eval_fn=None,
+                    guard=None):
         cfg = self.config.train
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
@@ -416,10 +421,13 @@ class JaxILQLTrainer(BaseRLTrainer):
                         samples_per_sec=clock.samples_per_second(),
                     )
                     log_fn(host)
-                if (
+                saved_now = (
                     self.iter_count % cfg.checkpoint_interval == 0
                     and self.iter_count > 0
-                ):
+                )
+                if saved_now:
                     self.save()
+                if self._preempt(log_fn, guard, just_saved=saved_now):
+                    return
                 if self.iter_count >= cfg.total_steps:
                     return

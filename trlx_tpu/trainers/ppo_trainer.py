@@ -459,7 +459,10 @@ class JaxPPOTrainer(BaseRLTrainer):
         tests/test_ppo_e2e.py::test_termination_either_bound.
 
         Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace of
-        the loop (trlx_tpu.utils.profiling)."""
+        the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
+        checkpoints at the next step boundary and returns cleanly
+        (train.save_on_preemption, trlx_tpu.utils.preemption)."""
+        from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import annotate, maybe_trace
 
         cfg = self.config.train
@@ -468,10 +471,10 @@ class JaxPPOTrainer(BaseRLTrainer):
         clock = Clock()
         self.maybe_resume()  # no-op when already restored at construction
 
-        with maybe_trace():
-            self._learn_loop(log_fn, cfg, m, clock, annotate)
+        with maybe_trace(), PreemptionGuard(cfg.save_on_preemption) as guard:
+            self._learn_loop(log_fn, cfg, m, clock, annotate, guard)
 
-    def _learn_loop(self, log_fn, cfg, m, clock, annotate):
+    def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None):
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
             loader = self.store.create_loader(
                 cfg.batch_size, shuffle=True, seed=self.epoch
@@ -507,6 +510,9 @@ class JaxPPOTrainer(BaseRLTrainer):
                         log_fn({"iter": self.iter_count, **ev})
                 if intervals["do_save"]:
                     self.save()
+                if self._preempt(log_fn, guard,
+                                 just_saved=intervals["do_save"]):
+                    return
                 if self.iter_count >= cfg.total_steps:
                     break
 
@@ -521,6 +527,8 @@ class JaxPPOTrainer(BaseRLTrainer):
                         m.num_rollouts, self.iter_count
                     )
                 log_fn({"iter": self.iter_count, "epoch": self.epoch, **info})
+                if self._preempt(log_fn, guard):
+                    return
 
     def post_rollout_kl_update(self, mean_kl: float, n_samples: int) -> None:
         self.kl_ctl.update(mean_kl, n_samples)
