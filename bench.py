@@ -453,11 +453,11 @@ def bench_gpt2_xl():
             **hbm}
 
 
-def bench_quality(cycles=50):
+def bench_quality(cycles=200):
     """Quality leg: the reference's learning instrumentation
     (mean_score + KL per rollout refresh — reference:
     trlx/model/accelerate_ppo_model.py:147-156, ppo_orchestrator.py:100-105)
-    over ~200 optimization steps.
+    over ~800 optimization steps.
 
     The headline trainer pairs gpt2's 50257 vocab with the byte tokenizer
     (throughput is weight- and token-semantics-independent), but that makes
@@ -468,13 +468,21 @@ def bench_quality(cycles=50):
     (examples/ppo_sentiments.py offline_pieces, tests/test_ppo_e2e.py): a
     byte-vocab from-config model, printable-ASCII logit mask, and the
     lowercase-ratio reward — genuinely learnable from a random init.
-    Measured here: mean_score rises ~0.34 -> 0.39+ over 200 steps while
-    the adaptive controller pins seq-KL at its target (~5-6 vs target 6) —
-    reward improves exactly as fast as the KL budget allows, the
-    "matched KL" regime the reference's instrumentation is calibrated
-    for. Real lvwerra/gpt2-imdb + distilbert-imdb are used instead when a
-    local HF cache can serve them (never downloads). Full trajectories go
-    to quality_curve.json; the bench line carries the summary."""
+
+    KL budget calibration: going all-lowercase from a uniform-over-
+    printables init costs ~log(95/26) = 1.3 nats/token, ~62 nats over the
+    48-token response — a seq-KL target of 6 (the reference's imdb value,
+    calibrated for a PRETRAINED starting policy) mathematically caps this
+    task at a tiny reward delta, which is why earlier rounds plateaued
+    near 0.38. The leg therefore budgets target=48 with a small initial
+    coefficient: measured (v5e, 200 cycles x 4 steps): mean_score
+    0.35 -> ~0.80 with seq-KL pinned at ~48-55 — reward converges hard
+    WHILE the controller holds KL at its target, the matched-KL regime
+    the reference's instrumentation defines. Real lvwerra/gpt2-imdb +
+    distilbert-imdb are used instead when a local HF cache can serve them
+    (never downloads; the controller then keeps the reference's own
+    target=6 regime). Full trajectories go to quality_curve.json; the
+    bench line carries the summary."""
     import jax
     import numpy as np
 
@@ -493,15 +501,15 @@ def bench_quality(cycles=50):
         "train": {
             "n_ctx": 64, "epochs": 1, "total_steps": 4, "batch_size": 64,
             "grad_clip": 1.0, "lr_ramp_steps": 0, "lr_decay_steps": 200,
-            "weight_decay": 1e-6, "learning_rate_init": 2e-3,
-            "learning_rate_target": 1e-3, "log_interval": 10**9,
+            "weight_decay": 1e-6, "learning_rate_init": 4e-3,
+            "learning_rate_target": 2e-3, "log_interval": 10**9,
             "checkpoint_interval": 10**9, "eval_interval": 10**9,
             "pipeline": "PPOPipeline", "orchestrator": "PPOOrchestrator",
             "input_size": 4, "gen_size": 48, "seed": 0,
         },
         "method": {
             "name": "ppoconfig", "num_rollouts": 64, "chunk_size": 64,
-            "ppo_epochs": 4, "init_kl_coef": 0.05, "target": 6,
+            "ppo_epochs": 4, "init_kl_coef": 0.002, "target": 48,
             "horizon": 10000, "gamma": 1, "lam": 0.95, "cliprange": 0.2,
             "cliprange_value": 0.2, "vf_coef": 1.0,
             "gen_kwargs": {"max_length": 48, "min_length": 48,
@@ -542,13 +550,23 @@ def bench_quality(cycles=50):
         mod = _il.module_from_spec(spec)
         spec.loader.exec_module(mod)
         reward_fn, _prompts = mod.online_pieces(qconfig)
-        real = True
-        log("quality leg: using local-cache gpt2-imdb/distilbert reward")
+        # real sentiment starts from a pretrained-quality policy: restore
+        # the reference's own KL regime (ppo_config.yml: coef 0.05,
+        # target 6) instead of the random-init synthetic budget above.
+        # Everything real-assets related happens BEFORE real=True so a
+        # failure can never half-apply (pretrained reward under the
+        # synthetic KL budget) — the except falls back to fully synthetic.
+        from trlx_tpu.trainers.kl_controllers import make_kl_controller
+
+        kl_ctl = make_kl_controller(0.05, 6.0, 10000)
         # rebind BOTH references: the orchestrator scores rollouts through
         # orch.reward_fn, but trainer.evaluate() scores through
         # trainer.reward_fn (bound at set_orchestrator time)
         orch.reward_fn = reward_fn
         trainer.reward_fn = reward_fn
+        trainer.kl_ctl = kl_ctl
+        real = True
+        log("quality leg: using local-cache gpt2-imdb/distilbert reward")
     except Exception:
         pass  # synthetic reward already wired
 
