@@ -453,6 +453,176 @@ def bench_gpt2_xl():
             **hbm}
 
 
+def bench_gptj6b():
+    """gpt-j-6B-shaped leg (random init, bfloat16) on the one real chip —
+    empirical validation of the memory-fit matrix
+    (docs/source/performance.rst) at the reference's flagship scale
+    (reference configs/ppo_gptj.yml:2).
+
+    The matrix says single-chip 6B PPO does NOT fit at any frozen dtype
+    (~19 GB with bf16 frozen storage vs 16 GB HBM); the shipped
+    configs/ppo_gptj.yml therefore pairs param_dtype: bfloat16 with an
+    fsdp=2 x tp=4 mesh. This leg checks both of the matrix's single-chip
+    claims on hardware:
+
+    1. the pre-flight memory check RAISES on the real device for the
+       single-chip 6B hydra — the "no" row is enforced against the real
+       bytes_limit, not just the mocked 16 GB of the unit test;
+    2. the 6B-scale transformer itself RUNS: bf16 weights random-built
+       on-device (~11.7 GB, the same arithmetic the matrix uses), fused
+       prefill + 48-token decode at the reference workload shape
+       (ppo_gptj.yml: batch 8, input 4, gen 48), recording tokens/s and
+       measured HBM.
+
+    The rollout+UPDATE cycle at 6B needs the shipped mesh; its sharded
+    program compiling + executing is validated by __graft_entry__.
+    dryrun_multichip on virtual devices — one chip simply cannot hold it,
+    which is exactly what this leg proves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.data.configs import ModelSpec, TRLConfig
+    from trlx_tpu.models.generation import GenerationConfig, generate
+    from trlx_tpu.models.transformer import (
+        init_block_params,
+        init_embed_params,
+        init_ln_f_params,
+    )
+    from trlx_tpu.ops.sampling import SamplingParams
+    from trlx_tpu.utils import tree_bytes
+    from trlx_tpu.utils.loading import get_model
+
+    spec = ModelSpec.preset("gpt-j-6b")
+    out = {}
+
+    # --- 1. the precheck fires on the real device ----------------------- #
+    stats = {}
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    if stats.get("bytes_limit") and not os.environ.get(
+        "TRLX_TPU_SKIP_MEMCHECK"
+    ):
+        import dataclasses
+
+        config = TRLConfig.from_dict({
+            "model": {
+                "model_path": "from-config", "tokenizer_path": "byte",
+                "model_type": "JaxPPOTrainer", "num_layers_unfrozen": 2,
+                # the same preset geometry the decode leg below measures —
+                # built from the dataclass so the two halves cannot drift
+                "model_spec": dataclasses.asdict(spec),
+                "param_dtype": "bfloat16", "compute_dtype": "bfloat16",
+            },
+            "train": {
+                "n_ctx": 512, "epochs": 1, "total_steps": 4,
+                "batch_size": 8, "grad_clip": 1.0, "lr_ramp_steps": 100,
+                "lr_decay_steps": 79000, "weight_decay": 1e-6,
+                "learning_rate_init": 1.412e-4,
+                "learning_rate_target": 1.412e-4, "log_interval": 10**9,
+                "checkpoint_interval": 10**9, "eval_interval": 10**9,
+                "pipeline": "PPOPipeline",
+                "orchestrator": "PPOOrchestrator",
+                "input_size": 4, "gen_size": 48, "seed": 0,
+            },
+            "method": {
+                "name": "ppoconfig", "num_rollouts": 8, "chunk_size": 8,
+                "ppo_epochs": 4, "gen_kwargs": {
+                    "max_length": 48, "min_length": 48, "top_k": 0,
+                    "top_p": 1.0, "do_sample": True,
+                },
+            },
+        })
+        try:
+            get_model(config.model.model_type)(config)
+            out["gptj6b_single_chip_precheck"] = "did_not_raise"
+        except ValueError:
+            out["gptj6b_single_chip_precheck"] = "raises_as_documented"
+        except Exception as e:
+            # the estimate is a deliberate lower bound: a device whose
+            # bytes_limit passes it can still OOM during the real init —
+            # record that outcome, keep the decode measurement below alive
+            out["gptj6b_single_chip_precheck"] = (
+                f"allocation failed post-precheck: {type(e).__name__}"
+            )
+        log(f"gpt-j-6B single-chip hydra precheck: "
+            f"{out['gptj6b_single_chip_precheck']}")
+    else:
+        # the tunneled runtime exposes no memory_stats()/bytes_limit, so
+        # neither the precheck nor HBM telemetry can fire here; the
+        # decode leg below is the empirical part (11.7 GB of weights
+        # resident + running IS the fits-on-chip evidence)
+        out["gptj6b_single_chip_precheck"] = (
+            "unavailable: runtime exposes no bytes_limit"
+        )
+
+    # --- 2. 6B decode on the chip (the part that DOES fit) --------------- #
+    B, P, G = 8, 4, 48
+
+    @jax.jit
+    def build(rng):
+        k1, k2 = jax.random.split(rng)
+        return (
+            init_embed_params(k1, spec, jnp.bfloat16),
+            init_block_params(k2, spec, spec.n_layer, jnp.bfloat16),
+            init_ln_f_params(spec, jnp.bfloat16),
+        )
+
+    embed, blocks, ln_f = build(jax.random.PRNGKey(0))
+    weights_gb = tree_bytes((embed, blocks, ln_f)) / 2**30
+    gen_config = GenerationConfig(
+        gen_size=G, sampling=SamplingParams(do_sample=True),
+        eos_token_id=-1, pad_token_id=0, min_new_tokens=G,
+    )
+    query = jnp.asarray(
+        np.random.default_rng(0).integers(0, spec.vocab_size, (B, P)),
+        jnp.int32,
+    )
+    qmask = jnp.ones((B, P), jnp.int32)
+
+    gen = jax.jit(
+        lambda e, b, l, rng: generate(
+            spec, b, e, l, query, qmask, rng, gen_config,
+            compute_dtype=jnp.bfloat16,
+        )
+    )
+    res = gen(embed, blocks, ln_f, jax.random.PRNGKey(1))  # compile
+    np.asarray(res.gen_tokens[:1, :1])
+    reps = 3
+    t0 = time.perf_counter()
+    for i in range(reps):
+        res = gen(embed, blocks, ln_f, jax.random.PRNGKey(2 + i))
+    np.asarray(res.gen_tokens[:1, :1])
+    dt = (time.perf_counter() - t0) / reps
+    tok_s = B * G / dt
+
+    hbm_gb = None
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        # bytes_in_use right after the timed decode = this leg's live
+        # footprint (weights + KV cache + buffers); peak_bytes_in_use is a
+        # PROCESS-lifetime high-water mark that earlier legs (xl PPO) set
+        if "bytes_in_use" in stats:
+            hbm_gb = round(stats["bytes_in_use"] / 2**30, 2)
+    except Exception:
+        pass
+    log(f"gpt-j-6B bf16 decode: {dt:.2f}s for [{B}, {P}+{G}] -> "
+        f"{tok_s:.0f} tok/s (weights {weights_gb:.2f} GB"
+        f"{f', HBM in use {hbm_gb} GB' if hbm_gb else ''})")
+    out.update({
+        "gptj6b_decode_tokens_per_sec": round(tok_s, 1),
+        "gptj6b_decode_samples_per_sec": round(B / dt, 2),
+        "gptj6b_weights_gb": round(weights_gb, 2),
+        "gptj6b_workload": "gptj-6B-shape bf16 decode b8 4+48tok "
+                           "(ref ppo_gptj.yml shape)",
+    })
+    if hbm_gb:
+        out["gptj6b_hbm_in_use_gb"] = hbm_gb
+    return out
+
+
 def bench_quality(cycles=200):
     """Quality leg: the reference's learning instrumentation
     (mean_score + KL per rollout refresh — reference:
@@ -708,6 +878,16 @@ def main():
     _reclaim_device_memory()
     log(f"[leg] gpt2-xl: {time.perf_counter() - t_leg:.0f}s")
 
+    # ---- gpt-j-6B-shaped leg (flagship-scale memory validation) ----------
+    t_leg = time.perf_counter()
+    try:
+        gptj6b = bench_gptj6b()
+    except Exception as e:
+        log(f"gptj6b bench skipped: {e!r}")
+        gptj6b = {}
+    _reclaim_device_memory()
+    log(f"[leg] gptj6b: {time.perf_counter() - t_leg:.0f}s")
+
     # ---- full rollout+update cycles (the headline) -----------------------
     cycles = 5  # min-of-5: tunnel variance swings single cycles ~10-15%
     per_cycle = []
@@ -726,8 +906,17 @@ def main():
         exp_times.append(t_exp)
         log(f"cycle {i}: {dt:.2f}s total (exp_time {t_exp:.2f}s, "
             f"update {dt - t_exp:.2f}s)")
+    # median is the headline (round-over-round deltas then track CODE, not
+    # methodology: min-of-N is stable against tunnel-sync noise spikes but
+    # drifts optimistic with N); min is recorded alongside for the noise
+    # floor
     best = min(per_cycle)
-    samples_per_sec = m.num_rollouts / best
+    med_idx = sorted(range(len(per_cycle)), key=per_cycle.__getitem__)[
+        len(per_cycle) // 2
+    ]
+    med = per_cycle[med_idx]
+    samples_per_sec_min = m.num_rollouts / best
+    samples_per_sec = m.num_rollouts / med
 
     # ---- quality: mean-reward + KL learning curve (~200 steps) -----------
     t_leg = time.perf_counter()
@@ -750,22 +939,35 @@ def main():
         # The BASELINE.json north star (">=4x vs 8xA100 Accelerate on
         # gpt2-xl") has no published denominator to divide by; the xl leg
         # below records our absolute gpt2-xl samples/s for when one exists.
-        "vs_baseline": round(samples_per_sec / prev, 3) if prev else 1.0,
+        # transition round: prior rounds recorded min-of-5 as `value`, so
+        # the numeric ratio compares min to min (apples-to-apples); from
+        # the next round on, `value` (median) / previous `value` (median)
+        # compares medians automatically
+        "vs_baseline": (
+            round(samples_per_sec_min / prev, 3) if prev else 1.0
+        ),
         "vs_baseline_denominator": (
-            f"{prev} samples/s/chip from {prev_src}" if prev
+            f"{prev} samples/s/chip (min-of-5) from {prev_src}; ratio "
+            f"uses this round's min-of-5 — `value` itself is the median"
+            if prev
             else "none: no prior parsed round; reference publishes no numbers"
         ),
+        "samples_per_sec_median_of_5": round(samples_per_sec, 3),
+        "samples_per_sec_min_of_5": round(samples_per_sec_min, 3),
         "workload": "ppo_sentiments gpt2-124M b128 4+48tok (ref ppo_config.yml)",
         "platform": f"{platform}:{gen or 'unknown'}",
         "decode_tokens_per_sec": round(decode_tok_s, 1),
         "train_step_ms": round(step_dt * 1e3, 2),
         "train_mfu": round(train_mfu, 4) if train_mfu else None,
         "decode_mfu": round(decode_mfu, 4) if decode_mfu else None,
-        "exp_time_sec": round(min(exp_times), 3),
-        "update_time_sec": round(best - min(exp_times), 3),
+        # components decompose the MEDIAN cycle (the one `value` reports):
+        # exp_time + update_time == med within timer noise
+        "exp_time_sec": round(exp_times[med_idx], 3),
+        "update_time_sec": round(med - exp_times[med_idx], 3),
         **long_ctx,
         **ilql,
         **xl,
+        **gptj6b,
         **quality,
     }
     print(json.dumps(result), flush=True)
