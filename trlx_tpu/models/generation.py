@@ -50,18 +50,57 @@ from trlx_tpu.ops.sampling import SamplingParams, sample_token
 
 Params = Dict[str, Any]
 
-# Above this depth the decode body switches from an unrolled layer loop to a
-# fori_loop. What makes the unrolled path fast is the per-layer TUPLE cache
-# leaves in the scan carry (measured: gpt2-xl 48L 9.7-11.8 ms/step unrolled
-# vs 14.7-15.7 for every stacked-carry variant, including group-chunked
-# unrolls — dynamic_update_index on a stacked cache costs the same as fori).
-# But the unrolled body also extends buffer live ranges: the same xl decode
-# that wins in isolation OOMs a 16 GB chip inside the fused rollout program,
-# where the scoring forward's [B, T, V] logits buffers share the peak. The
-# default keeps deep models on the O(1)-memory fori path; raise
-# TRLX_TPU_DECODE_UNROLL_MAX when decode headroom allows (decode-only
-# servers, sharded params).
-_UNROLL_MAX_LAYERS = int(os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX", "24"))
+# Depth ceiling for the unrolled decode body. What makes the unrolled path
+# fast is the per-layer TUPLE cache leaves in the scan carry (measured:
+# gpt2-xl 48L 9.7-11.8 ms/step unrolled vs 14.7-15.7 for every
+# stacked-carry variant, including group-chunked unrolls —
+# dynamic_update_index on a stacked cache costs the same as fori). The
+# unrolled body extends buffer live ranges, which OOMed the fused rollout
+# at gpt2-xl while the scoring forward still materialized [B, T, V] logits;
+# chunked scoring removed that peak, and the re-measured fused cycle now
+# WINS unrolled at 48 layers (61.3 -> 71.5 samples/s on v5e — see
+# docs/source/performance.rst). Default: unroll up to 48 layers, backing
+# off to fori when the runtime reports insufficient HBM headroom for the
+# cache's extended live range; TRLX_TPU_DECODE_UNROLL_MAX overrides both.
+_UNROLL_MAX_LAYERS = 48
+
+
+def _use_unrolled_layers(n_layers: int, cache_bytes: int) -> bool:
+    env = os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX")
+    if env is not None:
+        return n_layers <= int(env)
+    if n_layers > _UNROLL_MAX_LAYERS:
+        return False
+    try:  # memory-aware backoff (trace-time state; skipped when the
+        # runtime exposes no stats, e.g. tunneled devices)
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use", 0)
+        if limit and used + 2 * cache_bytes > 0.92 * limit:
+            return False
+    except Exception:
+        pass
+    return True
+
+
+def _sampling_key(rng: jax.Array) -> jax.Array:
+    """The caller's PRNG key converted to the `rbg` implementation for the
+    decode loop's per-step draws.
+
+    XLA lowers rbg to the TPU's hardware RngBitGenerator; threefry runs as
+    software kernels whose [B, V] gumbel bits measurably tax every step
+    (v5e, gpt2-124M [B=128, V=50257]: 1.37 -> 1.22 ms/step), and rbg also
+    partitions cleanly under pjit where threefry forms a bottleneck. The
+    same seed produces a DIFFERENT stream than threefry would — the
+    sampling stream was never a stability contract (determinism per seed
+    is preserved); the sampled distribution is identical."""
+    if jnp.issubdtype(rng.dtype, jnp.unsignedinteger):
+        data = rng  # raw [2] uint32 key (jax.random.PRNGKey style)
+    else:
+        if str(jax.random.key_impl(rng)) != "threefry2x32":
+            return rng  # already rbg/custom — respect the caller's choice
+        data = jax.random.key_data(rng)
+    return jax.random.wrap_key_data(jnp.tile(data, 2), impl="rbg")
 
 
 class GenerationConfig(NamedTuple):
@@ -153,6 +192,7 @@ def generate(
         )
     n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
 
+    rng = _sampling_key(rng)
     prompt_mask = prompt_mask.astype(jnp.int32)
     real_len = prompt_mask.sum(axis=-1)  # [B]
 
@@ -183,7 +223,11 @@ def generate(
 
     # -- decode scan ------------------------------------------------------
     flags = ArchFlags.for_spec(spec)
-    unroll_layers = n_layers <= _UNROLL_MAX_LAYERS
+    cache_bytes = (
+        2 * n_layers * B * S * spec.kv_heads * spec.head_dim
+        * jnp.dtype(cache_dtype).itemsize
+    )
+    unroll_layers = _use_unrolled_layers(n_layers, cache_bytes)
 
     def run_layers(cache, h, bias, pos, offset):
         """One token through all blocks with IN-PLACE cache updates.
@@ -238,15 +282,16 @@ def generate(
             step_logits = step_logits.at[:, config.eos_token_id].set(
                 jnp.where(suppress, NEG_INF, eos_col)
             )
-        # one log_softmax serves both the draw and the recorded (unwarped)
-        # logprob: every warper and categorical() itself is invariant to
-        # the per-row logsumexp shift, so sampling from the normalized
-        # distribution is identical and skips a second full-vocab pass
-        step_lsm = jax.nn.log_softmax(step_logits, axis=-1)
-        tok = sample_token(key, step_lsm, config.sampling)
+        # the normalized [B, V] distribution is never materialized: every
+        # warper and categorical() itself is invariant to the per-row
+        # logsumexp shift, so the draw runs on the raw logits and the
+        # recorded (unwarped) logprob is gather(logits, tok) - logsumexp —
+        # a fused reduction instead of a full-vocab log_softmax write+read
+        logz = jax.nn.logsumexp(step_logits, axis=-1)
+        tok = sample_token(key, step_logits, config.sampling)
         logprob = jnp.take_along_axis(
-            step_lsm, tok[:, None], axis=-1
-        )[:, 0]
+            step_logits, tok[:, None], axis=-1
+        )[:, 0] - logz
         tok = jnp.where(finished, jnp.int32(config.pad_token_id), tok)
         logprob = jnp.where(finished, 0.0, logprob)
         emitted_mask = ~finished
