@@ -623,6 +623,54 @@ def bench_gptj6b():
     return out
 
 
+def bench_gptj6b_isolated():
+    """bench_gptj6b in a CHILD process, for tunnel-runtime hygiene.
+
+    Measured on the tunneled v5e: an 11+ GB alloc/free cycle leaks on the
+    SERVER side even when the client frees every array (jax.live_arrays
+    reports ~0.6 GB yet subsequent tiny transfers RESOURCE_EXHAUST; two
+    full-bench runs reproduced it, a fresh process then allocates 12 GB
+    fine) — only client disconnect reliably releases the memory. The 6B
+    leg therefore runs isolated, and last among device legs.
+
+    Standard directly-attached runtimes allow ONE process per chip (a
+    child client would be refused while the parent holds the device) —
+    they also expose memory_stats() and don't exhibit the leak, so the
+    leg runs in-process there. The missing-stats signature selects the
+    tunneled path."""
+    import subprocess
+
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return bench_gptj6b()
+
+    code = (
+        "import json, bench; "
+        "print('GPTJ6B_JSON ' + json.dumps(bench.bench_gptj6b()), "
+        "flush=True)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=1500,
+    )
+    for line in (proc.stderr or "").splitlines():
+        if line.startswith(("gpt-j", "[")):
+            log(f"  (6b) {line}")
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("GPTJ6B_JSON "):
+            return json.loads(line[len("GPTJ6B_JSON "):])
+    raise RuntimeError(
+        f"gptj6b child produced no result (rc={proc.returncode}): "
+        f"{(proc.stderr or '')[-500:]}"
+    )
+
+
 def bench_quality(cycles=200):
     """Quality leg: the reference's learning instrumentation
     (mean_score + KL per rollout refresh — reference:
@@ -783,6 +831,13 @@ def _reclaim_device_memory():
     import gc
 
     gc.collect()
+    try:
+        import jax
+
+        live = sum(x.nbytes for x in jax.live_arrays()) / 2**30
+        log(f"[mem] live device arrays after reclaim: {live:.2f} GB")
+    except Exception:
+        pass
 
 
 def main():
@@ -878,16 +933,6 @@ def main():
     _reclaim_device_memory()
     log(f"[leg] gpt2-xl: {time.perf_counter() - t_leg:.0f}s")
 
-    # ---- gpt-j-6B-shaped leg (flagship-scale memory validation) ----------
-    t_leg = time.perf_counter()
-    try:
-        gptj6b = bench_gptj6b()
-    except Exception as e:
-        log(f"gptj6b bench skipped: {e!r}")
-        gptj6b = {}
-    _reclaim_device_memory()
-    log(f"[leg] gptj6b: {time.perf_counter() - t_leg:.0f}s")
-
     # ---- full rollout+update cycles (the headline) -----------------------
     cycles = 5  # min-of-5: tunnel variance swings single cycles ~10-15%
     per_cycle = []
@@ -927,6 +972,17 @@ def main():
         quality = {}
     _reclaim_device_memory()
     log(f"[leg] quality: {time.perf_counter() - t_leg:.0f}s")
+
+    # ---- gpt-j-6B-shaped leg: LAST + subprocess-isolated (its 11 GB
+    # alloc/free cycle leaks server-side on tunneled runtimes; see
+    # bench_gptj6b_isolated) ----------------------------------------------
+    t_leg = time.perf_counter()
+    try:
+        gptj6b = bench_gptj6b_isolated()
+    except Exception as e:
+        log(f"gptj6b bench skipped: {e!r}")
+        gptj6b = {}
+    log(f"[leg] gptj6b: {time.perf_counter() - t_leg:.0f}s")
 
     metric = "ppo_rollout_update_samples_per_sec"
     prev, prev_src = previous_round_value(metric)

@@ -65,18 +65,34 @@ Params = Dict[str, Any]
 _UNROLL_MAX_LAYERS = 48
 
 
-def _use_unrolled_layers(n_layers: int, cache_bytes: int) -> bool:
+def _use_unrolled_layers(n_layers: int, static_bytes: int) -> bool:
+    """Whether the decode body unrolls the layer loop.
+
+    `static_bytes`: weights + 2x KV cache, computed from shapes at trace
+    time — deliberately STATIC so the decision is deterministic for a
+    given (config, device type). Consulting live allocator state here
+    would bake whatever happened to be resident at first trace into the
+    compiled program: non-reproducible perf, and under multi-host SPMD
+    two hosts could compile different programs (different collective
+    sequences -> hang). bytes_limit is a hardware constant, identical
+    across same-generation hosts, so comparing the static estimate
+    against it is multi-host safe; runtimes that expose no stats (e.g.
+    tunneled devices) just use the depth ceiling."""
     env = os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX")
     if env is not None:
         return n_layers <= int(env)
     if n_layers > _UNROLL_MAX_LAYERS:
         return False
-    try:  # memory-aware backoff (trace-time state; skipped when the
-        # runtime exposes no stats, e.g. tunneled devices)
+    try:
+        if jax.device_count() > 1:
+            # sharded settings: tracer shapes are GLOBAL while bytes_limit
+            # is per-device — the comparison would wrongly force fori for
+            # models that fit fine per-chip; the depth ceiling (plus the
+            # env override) governs instead
+            return True
         stats = jax.local_devices()[0].memory_stats() or {}
         limit = stats.get("bytes_limit")
-        used = stats.get("bytes_in_use", 0)
-        if limit and used + 2 * cache_bytes > 0.92 * limit:
+        if limit and static_bytes > 0.9 * limit:
             return False
     except Exception:
         pass
@@ -227,7 +243,13 @@ def generate(
         2 * n_layers * B * S * spec.kv_heads * spec.head_dim
         * jnp.dtype(cache_dtype).itemsize
     )
-    unroll_layers = _use_unrolled_layers(n_layers, cache_bytes)
+    weight_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves((blocks, embed))
+    )
+    unroll_layers = _use_unrolled_layers(
+        n_layers, weight_bytes + 2 * cache_bytes
+    )
 
     def run_layers(cache, h, bias, pos, offset):
         """One token through all blocks with IN-PLACE cache updates.

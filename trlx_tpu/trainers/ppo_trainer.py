@@ -313,11 +313,25 @@ class JaxPPOTrainer(BaseRLTrainer):
             last_stats = jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
             return params, opt_state, last_stats
 
+        def train_multi_indexed(params, opt_state, store_batch: PPORLBatch,
+                                idx):
+            """train_multi on store rows `idx`, gathered INSIDE the one
+            dispatch. The device-resident store otherwise pays one eager
+            gather dispatch per batch field (7 of them) before the train
+            program — pure per-op dispatch latency on tunneled/remote
+            devices (same device-resident-indexing design as the ILQL
+            trainer's train_step_indexed)."""
+            batch = jax.tree_util.tree_map(lambda x: x[idx], store_batch)
+            return train_multi(params, opt_state, batch)
+
         self._generate_fn = jax.jit(generate_fn)
         self._rollout_fn = jax.jit(rollout_fn, static_argnames=())
         self._finalize_rewards = jax.jit(finalize_rewards)
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
         self._train_multi = jax.jit(train_multi, donate_argnums=(0, 1))
+        self._train_multi_indexed = jax.jit(
+            train_multi_indexed, donate_argnums=(0, 1)
+        )
 
     # -- BaseRLTrainer surface ------------------------------------------ #
 
@@ -474,22 +488,57 @@ class JaxPPOTrainer(BaseRLTrainer):
         with maybe_trace(), PreemptionGuard(cfg.save_on_preemption) as guard:
             self._learn_loop(log_fn, cfg, m, clock, annotate, guard)
 
+    def _batch_runner(self, cfg):
+        """(iterator, run, rows): one optimization-batch step per item.
+
+        Device-resident store + no mesh: the iterator yields INDEX arrays
+        and `run` gathers the rows inside the single train dispatch
+        (_train_multi_indexed) — the per-field eager gathers of a host
+        loader each pay dispatch latency on tunneled/remote devices.
+        Otherwise (host-side rollouts, or a mesh needing shard_batch):
+        the classic batch loader."""
+        from trlx_tpu.pipeline import batch_iterator
+
+        data = self.store._stacked()
+        if (
+            self.mesh is None
+            and data is not None
+            and self._device_resident(data)
+        ):
+            iterator = batch_iterator(
+                len(data), cfg.batch_size, True, self.epoch,
+                lambda idx: idx,
+            )
+
+            def run(idx):
+                return self._train_multi_indexed(
+                    self.params, self.opt_state, data,
+                    jnp.asarray(idx, jnp.int32),
+                )
+
+            return iterator, run, len
+        iterator = self.store.create_loader(
+            cfg.batch_size, shuffle=True, seed=self.epoch
+        )
+
+        def run(batch):
+            return self._train_multi(
+                self.params, self.opt_state, self._put(batch)
+            )
+
+        return iterator, run, lambda b: len(b.query_tensors)
+
     def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None):
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
-            loader = self.store.create_loader(
-                cfg.batch_size, shuffle=True, seed=self.epoch
-            )
-            for batch in loader:
-                batch = self._put(batch)
+            loader, run, rows = self._batch_runner(cfg)
+            for item in loader:
                 with annotate("ppo_update"):
                     # all ppo_epochs passes in ONE dispatch — per-dispatch
                     # latency on tunneled devices makes N separate train
                     # steps measurably slower than one scanned program
-                    self.params, self.opt_state, stats = self._train_multi(
-                        self.params, self.opt_state, batch
-                    )
+                    self.params, self.opt_state, stats = run(item)
                     self.iter_count += m.ppo_epochs
-                clock.tick(len(batch.query_tensors) * m.ppo_epochs)
+                clock.tick(rows(item) * m.ppo_epochs)
 
                 intervals = self.intervals(self.iter_count)
                 if intervals["do_log"]:
