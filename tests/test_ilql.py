@@ -311,3 +311,58 @@ def test_ilql_dataset_upload_fallback_matches_device_resident(monkeypatch):
     fallback = run(0)           # force the per-batch upload path
     for a, b in zip(resident, fallback):
         np.testing.assert_array_equal(a, b)
+
+@pytest.mark.parametrize("two_qs", [True, False])
+def test_ilql_losses_chunked_equivalent(two_qs):
+    """ilql_losses_chunked (per-T-chunk head projections + remat) must
+    match ilql_losses on loss, stats, AND gradients — it is the same math
+    with a different memory schedule."""
+    from trlx_tpu.ops.losses import ilql_losses_chunked
+
+    spec = ModelSpec(vocab_size=23, n_layer=2, n_head=4, d_model=32,
+                     n_positions=16)
+    net = ILQLModel(spec=spec, two_qs=two_qs, compute_dtype=jnp.float32)
+    params = net.init(jax.random.PRNGKey(0))
+    B, T = 3, 10
+    r = np.random.default_rng(5)
+    tokens = jnp.asarray(r.integers(0, 23, (B, T)), jnp.int32)
+    mask = jnp.asarray((r.random((B, T)) > 0.2).astype(np.int32))
+    rewards = jnp.asarray(r.normal(size=(B, T - 1)).astype(np.float32))
+    args = (0.97, 0.7, 0.1, 1.0)
+
+    def loss_ref(trainable):
+        p = {**params, "trainable": trainable}
+        logits, qs, tqs, vs = net.forward(p, tokens, mask)
+        return ilql_losses(logits, qs, tqs, vs, tokens, mask, rewards, *args)
+
+    def loss_chunked(trainable):
+        p = {**params, "trainable": trainable}
+        h = net.forward_hidden(p, tokens, mask)
+        lm_fn, q_fns, tq_fns, v_fn = net.head_fns(p)
+        return ilql_losses_chunked(
+            lm_fn, q_fns, tq_fns, v_fn(h), h, tokens, mask, rewards, *args,
+            chunk=4,  # force padding + multiple chunks at T=10
+        )
+
+    (l1, s1), g1 = jax.value_and_grad(loss_ref, has_aux=True)(
+        params["trainable"]
+    )
+    (l2, s2), g2 = jax.value_and_grad(loss_chunked, has_aux=True)(
+        params["trainable"]
+    )
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for k in s1:
+        np.testing.assert_allclose(
+            float(s1[k]), float(s2[k]), rtol=1e-5, err_msg=k
+        )
+    flat1 = jax.tree_util.tree_leaves_with_path(g1)
+    flat2 = dict(
+        (jax.tree_util.keystr(kp), x)
+        for kp, x in jax.tree_util.tree_leaves_with_path(g2)
+    )
+    for kp, a in flat1:
+        b = flat2[jax.tree_util.keystr(kp)]
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(kp),
+        )

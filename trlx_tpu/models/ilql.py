@@ -112,6 +112,24 @@ class ILQLModel:
         shift its hidden state (and hence V at the bootstrap target) away
         from the reference's.
         """
+        h_normed = self.forward_hidden(params, tokens, attention_mask)
+        lm_fn, q_fns, tq_fns, v_fn = self.head_fns(params)
+        logits = lm_fn(h_normed)
+        qs = tuple(f(h_normed) for f in q_fns)
+        target_qs = tuple(f(h_normed) for f in tq_fns)
+        return logits, qs, target_qs, v_fn(h_normed)
+
+    def forward_hidden(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """Trunk up to (and including) the final layernorm: [B, T, D].
+
+        Pair with `head_fns` + `ilql_losses_chunked` so the train step
+        never materializes the five [B, T, V] head outputs (see
+        trlx_tpu.ops.losses.ilql_losses_chunked)."""
         spec = self.spec
         B, T = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
@@ -128,29 +146,36 @@ class ILQLModel:
             params["trainable"]["blocks"], spec, h, mask_bias, positions,
             remat=self.remat, attention_fn=self._attn(),
         )
-        h_normed = layer_norm(
+        return layer_norm(
             params["trainable"]["ln_f"], h, spec.layer_norm_epsilon
         )
+
+    def head_fns(self, params: Params):
+        """(lm_fn, q_fns tuple, tq_fns tuple, v_fn): callables over a
+        post-ln_f hidden state — h [..., D] -> [..., V] for the first
+        three, -> [...] (squeezed) for v_fn; target fns stop their
+        gradient (parity: reference ilql_models.py:86-100)."""
         head_params = dict(params["frozen_base"]["embed"])
         if "lm_head" in params["trainable"]:
             head_params["lm_head"] = params["trainable"]["lm_head"]
-        logits = project_logits(head_params, spec, h_normed)
+        lm_fn = functools.partial(project_logits, head_params, self.spec)
 
-        qs = (head_apply(params["trainable"]["q1_head"], h_normed),)
-        target_qs = (
-            jax.lax.stop_gradient(
-                head_apply(params["target"]["q1_head"], h_normed)
-            ),
+        q_names = ("q1_head", "q2_head") if self.two_qs else ("q1_head",)
+        q_fns = tuple(
+            functools.partial(head_apply, params["trainable"][name])
+            for name in q_names
         )
-        if self.two_qs:
-            qs = qs + (head_apply(params["trainable"]["q2_head"], h_normed),)
-            target_qs = target_qs + (
-                jax.lax.stop_gradient(
-                    head_apply(params["target"]["q2_head"], h_normed)
-                ),
-            )
-        vs = head_apply(params["trainable"]["v_head"], h_normed).squeeze(-1)
-        return logits, qs, target_qs, vs
+        tq_fns = tuple(
+            (lambda h, p=params["target"][name]: jax.lax.stop_gradient(
+                head_apply(p, h)
+            ))
+            for name in q_names
+        )
+
+        def v_fn(h):
+            return head_apply(params["trainable"]["v_head"], h).squeeze(-1)
+
+        return lm_fn, q_fns, tq_fns, v_fn
 
     def heads_on_hidden(self, params: Params, h_normed: jnp.ndarray):
         """(min target Q [.., V], v [.., 1]) on a post-ln_f hidden state —

@@ -294,6 +294,122 @@ def ilql_losses(
     return loss, stats
 
 
+def ilql_losses_chunked(
+    lm_head_fn,
+    q_head_fns,
+    tq_head_fns,
+    vs: jnp.ndarray,
+    h_normed: jnp.ndarray,
+    tokens: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    rewards: jnp.ndarray,
+    gamma: float,
+    tau: float,
+    cql_scale: float,
+    awac_scale: float,
+    chunk: int = 16,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """`ilql_losses`, with every V-width head projection computed CHUNKED
+    over T under rematerialization — the [B, T, V] logits/Q/target-Q
+    tensors are never materialized.
+
+    The ILQL loss touches five V-width tensors (lm logits, q1/q2,
+    target-q1/q2): ~3 GB of fp32 activations at gpt2 vocab [64, 48] that
+    the non-chunked step writes, re-reads for the loss elementwise math,
+    and re-reads again in the backward pass — HBM traffic, not FLOPs, is
+    where the step time went. Every per-position loss term depends on the
+    full-V tensors only through gather-at-action and logsumexp, so each
+    T-chunk reduces to [B, c] statistics immediately; `jax.checkpoint` on
+    the scan body recomputes the chunk's projections in the backward pass
+    instead of storing them. Same math, same stats keys as `ilql_losses`
+    (equivalence-tested in tests/test_ilql.py).
+
+    lm_head_fn / q_head_fns / tq_head_fns: callables [B, c, D] ->
+    [B, c, V] (target fns must stop_gradient internally); vs: [B, T]
+    value-head output; remaining args as `ilql_losses`.
+    """
+    B, T, D = h_normed.shape
+    # labels[t] = action taken AT t (= tokens[t+1]); the last position is
+    # a dummy (sliced off in the [:, :-1] loss terms below); gathers use
+    # mode="clip" so out-of-vocab pad ids cannot poison masked positions
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1
+    )
+    pad = (-T) % chunk
+    h_p = jnp.pad(h_normed, ((0, 0), (0, pad), (0, 0))) if pad else h_normed
+    l_p = jnp.pad(labels, ((0, 0), (0, pad))) if pad else labels
+    n = h_p.shape[1] // chunk
+    h_chunks = h_p.reshape(B, n, chunk, D).transpose(1, 0, 2, 3)
+    l_chunks = l_p.reshape(B, n, chunk).transpose(1, 0, 2)
+    n_q = len(q_head_fns)
+
+    def body(_, xs):
+        h_c, lab_c = xs
+
+        def gather(x):
+            return jnp.take_along_axis(
+                x, lab_c[..., None], axis=-1, mode="clip"
+            )[..., 0]
+
+        out = []
+        lm = lm_head_fn(h_c)
+        out += [gather(lm), jax.nn.logsumexp(lm, axis=-1)]
+        for f in q_head_fns:
+            q = f(h_c)
+            out += [gather(q), jax.nn.logsumexp(q, axis=-1)]
+        for f in tq_head_fns:
+            out.append(gather(f(h_c)))
+        return None, tuple(out)
+
+    _, outs = jax.lax.scan(jax.checkpoint(body), None, (h_chunks, l_chunks))
+
+    def unchunk(y):  # [n, B, c] -> [B, T]
+        return y.transpose(1, 0, 2).reshape(B, n * chunk)[:, :T]
+
+    outs = tuple(unchunk(o) for o in outs)
+    lm_g, lm_lse = outs[0], outs[1]
+    q_g = tuple(outs[2 + 2 * i] for i in range(n_q))
+    q_lse = tuple(outs[3 + 2 * i] for i in range(n_q))
+    tq_g = outs[2 + 2 * n_q:]
+
+    nonterminal = attention_mask[:, :-1].astype(jnp.float32)
+    n_nonterminal = jnp.maximum(nonterminal.sum(), 1.0)
+
+    Qs = tuple(g[:, :-1] for g in q_g)
+    targetQ = tq_g[0][:, :-1]
+    if len(tq_g) > 1:
+        targetQ = jnp.minimum(targetQ, tq_g[1][:, :-1])
+    targetQ = jax.lax.stop_gradient(targetQ)
+
+    V_next = vs[:, 1:] * nonterminal
+    Q_ = jax.lax.stop_gradient(rewards + gamma * V_next)
+    loss_q = sum(
+        (((Q - Q_) * nonterminal) ** 2).sum() / n_nonterminal for Q in Qs
+    )
+
+    V = vs[:, 1:] * nonterminal
+    diff = targetQ - V
+    weight = jnp.where(targetQ >= V, tau, 1.0 - tau)
+    loss_v = (weight * diff**2 * nonterminal).sum() / n_nonterminal
+
+    def masked_ce(g, lse):
+        lp = (g - lse)[:, :-1]
+        return (-(lp) * nonterminal).sum() / n_nonterminal
+
+    loss_cql = sum(masked_ce(g, lse) for g, lse in zip(q_g, q_lse))
+    loss_awac = masked_ce(lm_g, lm_lse)
+
+    loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
+    stats = {
+        "loss": loss,
+        "loss_q": loss_q,
+        "loss_v": loss_v,
+        "loss_cql": loss_cql,
+        "loss_awac": loss_awac,
+    }
+    return loss, stats
+
+
 def kl_penalty_rewards(
     logprobs: jnp.ndarray,
     ref_logprobs: jnp.ndarray,

@@ -30,7 +30,7 @@ from trlx_tpu.data.ilql_types import ILQLBatch
 from trlx_tpu.models.generation import GenerationConfig, generate
 from trlx_tpu.models.hf_import import ilql_params_from_trunk
 from trlx_tpu.models.ilql import ILQLModel as ILQLNet, sync_targets
-from trlx_tpu.ops.losses import ilql_losses
+from trlx_tpu.ops.losses import ilql_losses_chunked
 from trlx_tpu.ops.sampling import SamplingParams, warp_top_k
 from trlx_tpu.trainers import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import Clock, rampup_decay_schedule
@@ -130,11 +130,16 @@ class JaxILQLTrainer(BaseRLTrainer):
         def train_step(params, opt_state, batch: ILQLBatch):
             def loss_fn(trainable):
                 p = {**params, "trainable": trainable}
-                logits, qs, target_qs, vs = net.forward(
+                # chunked heads: the five [B, T, V] head tensors (~3 GB
+                # fp32 at gpt2 vocab [64, 48]) were the step's HBM-traffic
+                # bound; per-T-chunk projections reduce to gather/lse
+                # immediately and remat in the backward
+                h_normed = net.forward_hidden(
                     p, batch.input_ids, batch.attention_mask
                 )
-                return ilql_losses(
-                    logits, qs, target_qs, vs,
+                lm_fn, q_fns, tq_fns, v_fn = net.head_fns(p)
+                return ilql_losses_chunked(
+                    lm_fn, q_fns, tq_fns, v_fn(h_normed), h_normed,
                     batch.input_ids, batch.attention_mask, batch.rewards,
                     m.gamma, m.tau, m.cql_scale, m.awac_scale,
                 )
