@@ -1,7 +1,10 @@
 """Worker process for the two-process jax.distributed test.
 
 Spawned twice by tests/test_parallel.py::test_two_process_distributed_cpu
-(`python tests/distributed_worker.py <coordinator> <rank>`). Each process
+(`python tests/distributed_worker.py <coordinator> <rank> [mesh_json]`;
+the optional third argv is a JSON mesh spec — default pure-dp, while the
+fsdp=8 variant shards every parameter across both processes so forwards
+and backwards all-gather over the process boundary). Each process
 brings up the multi-host runtime through `initialize_runtime`'s explicit
 path (the layer the reference validated with two `accelerate launch`
 nodes — reference trlx/model/accelerate_base_model.py:54-55), then runs a
@@ -27,6 +30,16 @@ import sys
 
 def main():
     coordinator, rank = sys.argv[1], int(sys.argv[2])
+    # optional mesh spec (JSON) — default: pure data parallel; the fsdp
+    # variant shards every parameter across ALL 8 devices, so each forward
+    # all-gathers across the process boundary (cross-host collectives on
+    # the critical path, not just reward broadcast)
+    import json as _json
+
+    mesh_spec = (
+        _json.loads(sys.argv[3]) if len(sys.argv) > 3
+        else {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+    )
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     os.environ.setdefault("HF_HUB_OFFLINE", "1")
@@ -64,7 +77,7 @@ def main():
         total_steps=2, epochs=1, ppo_epochs=1, num_rollouts=16,
         chunk_size=16, batch_size=16,
     )
-    config.train.mesh = {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+    config.train.mesh = mesh_spec
     trainer = get_model(config.model.model_type)(config)
     trainer.tokenizer = ByteTokenizer()
     pipeline = get_pipeline(config.train.pipeline)(
@@ -93,9 +106,15 @@ def main():
     # --- params bit-identical across processes -------------------------- #
     from jax.experimental import multihost_utils
 
-    leaves = jax.tree_util.tree_leaves(trainer.params["trainable"])
+    # params sharded ACROSS processes (the fsdp-spanning mesh) are not
+    # host-fetchable directly; ONE pytree allgather materializes the
+    # global values on every rank
+    gathered = multihost_utils.process_allgather(
+        trainer.params["trainable"], tiled=True
+    )
     blob = b"".join(
-        np.ascontiguousarray(np.asarray(x)).tobytes() for x in leaves
+        np.ascontiguousarray(np.asarray(x)).tobytes()
+        for x in jax.tree_util.tree_leaves(gathered)
     )
     digest = np.frombuffer(
         hashlib.sha256(blob).digest()[:8], dtype=np.uint64

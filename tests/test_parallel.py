@@ -377,12 +377,19 @@ def test_broadcast_host_floats_uses_process0_when_multihost(monkeypatch):
     np.testing.assert_array_equal(out, [1.0, 2.0])
     assert out.dtype == np.float32
 
-def test_two_process_distributed_cpu(tmp_path):
+@pytest.mark.parametrize("mesh_spec", [
+    None,  # pure dp over both processes
+    # every parameter sharded over all 8 devices: forwards/backwards
+    # all-gather ACROSS the process boundary
+    {"dp": 1, "fsdp": 8, "tp": 1, "sp": 1},
+])
+def test_two_process_distributed_cpu(tmp_path, mesh_spec):
     """Bring up jax.distributed across TWO real processes (the multi-host
     layer everything else only exercises single-process): explicit
-    initialize_runtime, a dp mesh spanning both, broadcast_host_floats
+    initialize_runtime, a mesh spanning both, broadcast_host_floats
     overriding rank-1's divergent rewards, and bit-identical trained params
     (see tests/distributed_worker.py for the per-process assertions)."""
+    import json
     import os
     import socket
     import subprocess
@@ -409,9 +416,11 @@ def test_two_process_distributed_cpu(tmp_path):
     # fill a pipe buffer and deadlock the sibling in a collective while
     # the parent blocks on the other child
     logs = [tmp_path / f"rank{rank}.log" for rank in (0, 1)]
+    argv_tail = [] if mesh_spec is None else [json.dumps(mesh_spec)]
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), coordinator, str(rank)],
+            [sys.executable, str(worker), coordinator, str(rank)]
+            + argv_tail,
             cwd=root, env=env,
             stdout=open(log, "w"), stderr=subprocess.STDOUT,
         )
