@@ -10,8 +10,8 @@ hangs off.
 
 Axis order matters for ICI locality: tp (highest-bandwidth, innermost) is
 last so tensor-parallel collectives ride neighbouring chips, then sp, fsdp,
-dp outermost — the standard TPU layout (dp may cross DCN on multi-slice
-topologies, tp must not).
+pp (point-to-point activation hops), dp outermost — the standard TPU
+layout (dp may cross DCN on multi-slice topologies, tp must not).
 """
 
 import math
@@ -23,7 +23,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 # Outer-to-inner axis order; see module docstring.
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "pp", "fsdp", "sp", "tp")
 
 
 def resolve_axis_sizes(

@@ -69,6 +69,16 @@ class BaseRLTrainer:
         initialize_runtime()
         # mesh: explicit > config (TrainConfig.mesh) > None (single device)
         self.mesh = mesh if mesh is not None else mesh_from_config(config.train)
+        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
+            # pp is an op-level capability today (trlx_tpu.ops.
+            # pipeline_parallel, numerically verified); the trainers'
+            # forward paths do not pipeline yet, so pp > 1 here would
+            # silently replicate work across a whole device slice
+            raise ValueError(
+                "train.mesh pp > 1 is not consumed by the trainers yet — "
+                "the GPipe op lives in trlx_tpu.ops.pipeline_parallel; "
+                "use dp/fsdp/tp/sp in train.mesh"
+            )
 
     # -- SPMD helpers (shared by all trainers) --------------------------- #
 
