@@ -80,16 +80,17 @@ def pp_apply_blocks(
     attention_fn = attention_fn or attention_scores
     pp = mesh.shape["pp"]
     B = h.shape[0]
+    if pp == 1:
+        # unconditional passthrough: no microbatching constraints apply
+        return apply_blocks(
+            blocks, spec, h, mask_bias, positions,
+            attention_fn=attention_fn,
+        )
     if B % n_micro:
         raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
     L = jax.tree_util.tree_leaves(blocks)[0].shape[0]
     if L % pp:
         raise ValueError(f"n_layer {L} not divisible by pp={pp}")
-    if pp == 1:
-        return apply_blocks(
-            blocks, spec, h, mask_bias, positions,
-            attention_fn=attention_fn,
-        )
 
     def split(x):  # [B, ...] -> [n_micro, B/n_micro, ...]
         return x.reshape((n_micro, B // n_micro) + x.shape[1:])
