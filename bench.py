@@ -552,7 +552,7 @@ def bench_gptj6b():
     else:
         # the tunneled runtime exposes no memory_stats()/bytes_limit, so
         # neither the precheck nor HBM telemetry can fire here; the
-        # decode leg below is the empirical part (11.7 GB of weights
+        # decode leg below is the empirical part (11.3 GB of weights
         # resident + running IS the fits-on-chip evidence)
         out["gptj6b_single_chip_precheck"] = (
             "unavailable: runtime exposes no bytes_limit"
@@ -934,13 +934,16 @@ def main():
     log(f"[leg] gpt2-xl: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- full rollout+update cycles (the headline) -----------------------
+    def reset_cycle():
+        trainer.store.clear_history()
+        trainer.iter_count = 0
+        trainer.epoch = 0
+
     cycles = 5  # min-of-5: tunnel variance swings single cycles ~10-15%
     per_cycle = []
     exp_times = []
     for i in range(cycles):
-        trainer.store.clear_history()
-        trainer.iter_count = 0
-        trainer.epoch = 0
+        reset_cycle()
         t0 = time.perf_counter()
         info = orch.make_experience(m.num_rollouts)
         t_exp = time.perf_counter() - t0
@@ -974,9 +977,7 @@ def main():
         n_cont = 10
         t0 = time.perf_counter()
         for _ in range(n_cont):
-            trainer.store.clear_history()
-            trainer.iter_count = 0
-            trainer.epoch = 0
+            reset_cycle()
             orch.make_experience(m.num_rollouts)
             trainer.learn(log_fn=lambda s: None)
         jax.block_until_ready(trainer.params["trainable"])

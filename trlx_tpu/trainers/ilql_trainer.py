@@ -340,8 +340,9 @@ class JaxILQLTrainer(BaseRLTrainer):
         from trlx_tpu.utils.profiling import maybe_trace
 
         self.maybe_resume()  # no-op when already restored at construction
-        enabled = getattr(self.config.train, "save_on_preemption", True)
-        with maybe_trace(), PreemptionGuard(enabled) as guard:
+        with maybe_trace(), PreemptionGuard(
+            self.config.train.save_on_preemption
+        ) as guard:
             self._learn_loop(log_fn, save_fn, eval_fn, guard)
 
     def _learn_loop(self, log_fn=None, save_fn=None, eval_fn=None,
