@@ -469,7 +469,7 @@ def bench_gptj6b():
        single-chip 6B hydra — the "no" row is enforced against the real
        bytes_limit, not just the mocked 16 GB of the unit test;
     2. the 6B-scale transformer itself RUNS: bf16 weights random-built
-       on-device (~11.7 GB, the same arithmetic the matrix uses), fused
+       on-device (~11.3 GB, the same arithmetic the matrix uses), fused
        prefill + 48-token decode at the reference workload shape
        (ppo_gptj.yml: batch 8, input 4, gen 48), recording tokens/s and
        measured HBM.
