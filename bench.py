@@ -245,12 +245,15 @@ def bench_long_context(peak, T=4096, B=2):
     log(f"long-ctx train_step (T={T}, fused_attention={fused}): "
         f"{dt*1e3:.1f} ms ({tok_s:,.0f} tok/s)"
         f"{f', MFU {mfu:.1%}' if mfu else ''}")
+    # canonical 4k leg keeps the round-comparable bare keys; any other
+    # length gets a length-tagged prefix (no silent aliasing)
+    prefix = "long_ctx" if T == 4096 else f"long_ctx{T // 1024}k"
     return {
-        "long_ctx_tokens": T,
-        "long_ctx_train_ms": round(dt * 1e3, 1),
-        "long_ctx_tokens_per_sec": round(tok_s, 1),
-        "long_ctx_mfu": round(mfu, 4) if mfu else None,
-        "long_ctx_fused_attention": fused,
+        f"{prefix}_tokens": T,
+        f"{prefix}_train_ms": round(dt * 1e3, 1),
+        f"{prefix}_tokens_per_sec": round(tok_s, 1),
+        f"{prefix}_mfu": round(mfu, 4) if mfu else None,
+        f"{prefix}_fused_attention": fused,
     }
 
 
@@ -910,6 +913,14 @@ def main():
     except Exception as e:  # must not sink the headline metric
         log(f"long-context bench skipped: {e!r}")
         long_ctx = {}
+    # 8k leg: the length where the Pallas kernels' measured ~11x over
+    # dense XLA kicks in — keeps the long-context claim reproducible
+    # every round, not a one-time number in the docs
+    _reclaim_device_memory()  # a failed 4k leg must not poison this one
+    try:
+        long_ctx.update(bench_long_context(peak, T=8192, B=1))
+    except Exception as e:
+        log(f"8k-context bench skipped: {e!r}")
     _reclaim_device_memory()
     log(f"[leg] long-context: {time.perf_counter() - t_leg:.0f}s")
 
