@@ -272,3 +272,58 @@ def test_preemption_guard_disabled_by_config(tmp_path):
     with PreemptionGuard(enabled=False) as guard:
         assert signal.getsignal(signal.SIGTERM) is prev
         assert not guard.requested
+
+
+def test_preemption_poll_interval_skips_collectives(monkeypatch):
+    """Multi-process poll() runs its allgather only every poll_interval-th
+    call (ADVICE r04: a per-step collective through a ~100ms/sync tunnel
+    dwarfs small-model step time). Between collective boundaries it returns
+    False even with the local flag set — a rank acting on local state alone
+    would exit mid-collective and deadlock the survivors."""
+    import numpy as np
+
+    from trlx_tpu.utils import preemption
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    calls = {"allgather": 0}
+
+    def fake_allgather(x):
+        calls["allgather"] += 1
+        return np.stack([np.asarray(x), np.asarray([1.0], np.float32)])
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", fake_allgather
+    )
+
+    guard = PreemptionGuard(poll_interval=4)
+    guard.requested = True
+    # call 1 is a collective boundary (fires, sees the remote flag);
+    # calls 2-4 are skipped entirely; call 5 fires again
+    results = [guard.poll() for _ in range(5)]
+    assert results == [True, False, False, False, True]
+    assert calls["allgather"] == 2
+    assert preemption is not None  # keep the import referenced
+
+
+def test_preemption_guard_restores_sig_dfl_for_c_handlers(monkeypatch):
+    """When the previous SIGTERM handler was installed at the C level
+    (getsignal() -> None), __exit__ restores SIG_DFL rather than leaving
+    the guard's recording handler live (ADVICE r04: a swallowed SIGTERM
+    after learn() returns makes the process undrainable)."""
+    import signal
+
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    real_getsignal = signal.getsignal
+    monkeypatch.setattr(signal, "getsignal", lambda sig: None)
+    try:
+        with PreemptionGuard():
+            pass
+        monkeypatch.setattr(signal, "getsignal", real_getsignal)
+        assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)

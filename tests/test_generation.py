@@ -197,3 +197,54 @@ def test_fori_decode_path_matches_unrolled(arch, monkeypatch):
     fori = run()
     for a, b in zip(unrolled, fori):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sampling_key_accepts_raw_rbg_data():
+    """ADVICE r04: raw 4-word uint32 key data is already rbg-shaped — it
+    must wrap as-is (tiling to 8 words raises inside wrap_key_data), and
+    2-word threefry-style data still tiles to 4. Unknown widths pass
+    through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.generation import _sampling_key
+
+    raw4 = jnp.arange(4, dtype=jnp.uint32)
+    k4 = _sampling_key(raw4)
+    assert str(jax.random.key_impl(k4)) == "rbg"
+    jax.random.uniform(k4)  # usable
+
+    raw2 = jnp.arange(2, dtype=jnp.uint32)
+    k2 = _sampling_key(raw2)
+    assert str(jax.random.key_impl(k2)) == "rbg"
+    jax.random.uniform(k2)
+
+    raw3 = jnp.arange(3, dtype=jnp.uint32)
+    assert _sampling_key(raw3) is raw3
+
+    # typed non-threefry keys pass through with their stream intact
+    rbg_key = jax.random.key(0, impl="rbg")
+    assert _sampling_key(rbg_key) is rbg_key
+
+
+def test_per_device_nbytes_eager_vs_tracer():
+    """Eager arrays report a real per-device footprint (replicated ==
+    global); jit tracers are uninspectable and return None so the decode
+    unroll decision falls back to the depth ceiling (ADVICE r04)."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.generation import _per_device_nbytes
+
+    x = jnp.ones((8, 4), jnp.float32)
+    assert _per_device_nbytes([x]) == 8 * 4 * 4
+
+    seen = {}
+
+    @jax.jit
+    def f(y):
+        seen["val"] = _per_device_nbytes([y])
+        return y
+
+    f(x)
+    assert seen["val"] is None

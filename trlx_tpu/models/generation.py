@@ -26,6 +26,7 @@ a fori_loop over layers with the stacked cache carried whole (same in-place
 property, O(1) program size; ~14% slower at 12 layers).
 """
 
+import math
 import os
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
@@ -47,6 +48,7 @@ from trlx_tpu.models.transformer import (
     project_logits,
 )
 from trlx_tpu.ops.sampling import SamplingParams, sample_token
+from trlx_tpu.utils import tree_bytes
 
 Params = Dict[str, Any]
 
@@ -65,7 +67,27 @@ Params = Dict[str, Any]
 _UNROLL_MAX_LAYERS = 48
 
 
-def _use_unrolled_layers(n_layers: int, static_bytes: int) -> bool:
+def _per_device_nbytes(leaves) -> "int | None":
+    """Best-effort PER-DEVICE footprint of concrete arrays, via their
+    shardings' shard shapes. None when any leaf is not inspectable (jit
+    tracers carry global shapes and no committed sharding) — callers fall
+    back to depth-only heuristics then."""
+    total = 0
+    for x in leaves:
+        sharding = getattr(x, "sharding", None)
+        shard_shape = getattr(sharding, "shard_shape", None)
+        if shard_shape is None:
+            return None
+        try:
+            total += math.prod(shard_shape(x.shape)) * x.dtype.itemsize
+        except Exception:
+            return None
+    return total
+
+
+def _use_unrolled_layers(
+    n_layers: int, static_bytes: int, bytes_are_per_device: bool = True
+) -> bool:
     """Whether the decode body unrolls the layer loop.
 
     `static_bytes`: weights + 2x KV cache, computed from shapes at trace
@@ -77,18 +99,22 @@ def _use_unrolled_layers(n_layers: int, static_bytes: int) -> bool:
     sequences -> hang). bytes_limit is a hardware constant, identical
     across same-generation hosts, so comparing the static estimate
     against it is multi-host safe; runtimes that expose no stats (e.g.
-    tunneled devices) just use the depth ceiling."""
+    tunneled devices) just use the depth ceiling.
+
+    `bytes_are_per_device`: False when the caller could only compute a
+    GLOBAL estimate under a multi-device mesh (jit tracers hide the
+    param sharding) — then the comparison against per-device bytes_limit
+    would wrongly force fori for models that fit fine per chip, so the
+    depth ceiling governs. Callers that CAN resolve per-device bytes
+    (eager arrays — including pure-dp replication, where per-device
+    equals global) keep the HBM-headroom backoff."""
     env = os.environ.get("TRLX_TPU_DECODE_UNROLL_MAX")
     if env is not None:
         return n_layers <= int(env)
     if n_layers > _UNROLL_MAX_LAYERS:
         return False
     try:
-        if jax.device_count() > 1:
-            # sharded settings: tracer shapes are GLOBAL while bytes_limit
-            # is per-device — the comparison would wrongly force fori for
-            # models that fit fine per-chip; the depth ceiling (plus the
-            # env override) governs instead
+        if jax.device_count() > 1 and not bytes_are_per_device:
             return True
         stats = jax.local_devices()[0].memory_stats() or {}
         limit = stats.get("bytes_limit")
@@ -111,11 +137,19 @@ def _sampling_key(rng: jax.Array) -> jax.Array:
     sampling stream was never a stability contract (determinism per seed
     is preserved); the sampled distribution is identical."""
     if jnp.issubdtype(rng.dtype, jnp.unsignedinteger):
-        data = rng  # raw [2] uint32 key (jax.random.PRNGKey style)
+        data = rng  # raw uint32 key data (jax.random.PRNGKey style)
     else:
         if str(jax.random.key_impl(rng)) != "threefry2x32":
             return rng  # already rbg/custom — respect the caller's choice
         data = jax.random.key_data(rng)
+    # rbg keys are 4 uint32 words; threefry keys are 2. Raw 4-word data is
+    # already rbg-shaped — wrap as-is (tiling it to 8 would make
+    # wrap_key_data raise). Any other width is not a key we know how to
+    # convert; leave the sampling stream to the caller's implementation.
+    if data.shape[-1] == 4:
+        return jax.random.wrap_key_data(data, impl="rbg")
+    if data.shape[-1] != 2:
+        return rng
     return jax.random.wrap_key_data(jnp.tile(data, 2), impl="rbg")
 
 
@@ -243,13 +277,28 @@ def generate(
         2 * n_layers * B * S * spec.kv_heads * spec.head_dim
         * jnp.dtype(cache_dtype).itemsize
     )
-    weight_bytes = sum(
-        x.size * x.dtype.itemsize
-        for x in jax.tree_util.tree_leaves((blocks, embed))
-    )
-    unroll_layers = _use_unrolled_layers(
-        n_layers, weight_bytes + 2 * cache_bytes
-    )
+    weight_leaves = jax.tree_util.tree_leaves((blocks, embed))
+    per_device_weights = _per_device_nbytes(weight_leaves)
+    if per_device_weights is not None:
+        # Eager arrays: real per-device weight footprint (replicated params
+        # — e.g. pure dp — come out equal to global, so near-limit models
+        # still back off to fori). The cache is created inside this program
+        # and inherits the batch sharding; scale its estimate by the
+        # prompt's per-device batch fraction when that too is inspectable.
+        batch_scale = 1.0
+        per_device_prompt = _per_device_nbytes([prompt_tokens])
+        if per_device_prompt is not None and prompt_tokens.size:
+            batch_scale = per_device_prompt / (
+                prompt_tokens.size * prompt_tokens.dtype.itemsize
+            )
+        static_bytes = per_device_weights + 2 * int(cache_bytes * batch_scale)
+        unroll_layers = _use_unrolled_layers(n_layers, static_bytes)
+    else:
+        weight_bytes = tree_bytes(weight_leaves)
+        unroll_layers = _use_unrolled_layers(
+            n_layers, weight_bytes + 2 * cache_bytes,
+            bytes_are_per_device=jax.device_count() == 1,
+        )
 
     def run_layers(cache, h, bias, pos, offset):
         """One token through all blocks with IN-PLACE cache updates.

@@ -340,8 +340,11 @@ class JaxILQLTrainer(BaseRLTrainer):
         from trlx_tpu.utils.profiling import maybe_trace
 
         self.maybe_resume()  # no-op when already restored at construction
+        # capped like the PPO loop: bounded detection latency vs eviction
+        # grace windows, 1/8th the per-step collective rate
         with maybe_trace(), PreemptionGuard(
-            self.config.train.save_on_preemption
+            self.config.train.save_on_preemption,
+            poll_interval=min(self.config.train.log_interval, 8),
         ) as guard:
             self._learn_loop(log_fn, save_fn, eval_fn, guard)
 

@@ -26,11 +26,19 @@ class PreemptionGuard:
     so the trap is scoped to the learn loop.
     """
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, poll_interval: int = 1):
         self.requested = False
         self._enabled = enabled
         self._prev = None
         self._installed = False
+        # Cross-process agreement runs a collective; on high-dispatch-latency
+        # runtimes (~100ms/sync through a tunnel) doing that EVERY step can
+        # dwarf small-model step time. Callers pass a deterministic interval
+        # (trainers use min(train.log_interval, 8) — capped so worst-case
+        # detection lag stays within eviction grace windows) so all ranks
+        # hit the allgather at the same boundaries and skip it in between.
+        self._poll_interval = max(1, int(poll_interval))
+        self._polls = 0
 
     def _on_signal(self, signum, frame):
         self.requested = True
@@ -44,11 +52,19 @@ class PreemptionGuard:
         survivors — and, off process 0, its save() is a gated no-op, so
         nothing would be written at all. Every rank calls poll() at the
         same step boundaries, so the tiny allgather is itself a safe
-        collective. Single-process: just the local flag."""
+        collective — and it only actually runs every ``poll_interval``-th
+        call (the call COUNT is rank-deterministic, so ranks agree on which
+        boundaries are collective ones; between them poll() returns False
+        even if the local flag is set, because a rank acting on local state
+        alone is exactly the deadlock this method exists to prevent).
+        Single-process: just the local flag, every call."""
         import jax
 
         if jax.process_count() == 1:
             return self.requested
+        self._polls += 1
+        if (self._polls - 1) % self._poll_interval:
+            return False
         import numpy as np
         from jax.experimental import multihost_utils
 
@@ -70,8 +86,13 @@ class PreemptionGuard:
     def __exit__(self, *exc) -> bool:
         if self._installed:
             # getsignal() returns None for handlers installed outside
-            # Python (C level); those cannot be re-installed via signal()
-            if self._prev is not None:
-                signal.signal(signal.SIGTERM, self._prev)
+            # Python (C level); those cannot be re-installed via signal().
+            # Fall back to SIG_DFL rather than leaving our recording handler
+            # live — after learn() returns nobody polls the flag, and a
+            # swallowed SIGTERM would make the process undrainable.
+            signal.signal(
+                signal.SIGTERM,
+                self._prev if self._prev is not None else signal.SIG_DFL,
+            )
             self._installed = False
         return False
