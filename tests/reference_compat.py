@@ -1,0 +1,368 @@
+"""Run the ACTUAL reference implementation (/root/reference trlx:
+torch + accelerate) on CPU at toy scale, for behavioral head-to-head
+comparison with trlx_tpu (tests/test_reference_head_to_head.py).
+
+The reference targets the 2022 stack (transformers 4.21 / accelerate 0.12
+/ wandb / torchtyping); this environment ships the 2026 stack. No
+reference code is modified — `install_shims()` restores the 4.21-era
+surfaces the reference was written against, and each shim documents the
+exact drift it bridges:
+
+1. `wandb` / `torchtyping` are not installed -> stub modules (the
+   reference only uses wandb.Table/watch/init/log and annotation-only
+   TensorType).
+2. `transformers.top_k_top_p_filtering` was removed in 4.27 -> reimplement
+   (used by reference trlx/model/nn/ppo_models.py:11).
+3. accelerate 1.14's tracker probe (`importlib.util.find_spec("wandb")`)
+   raises on a specless stub -> the stub carries a real ModuleSpec, and
+   `get_available_trackers` is patched to [] so `Accelerator(
+   log_with="wandb")` (reference accelerate_base_model.py:53) degrades to
+   the no-tracker path instead of driving the stub through WandBTracker.
+4. transformers 4.57's GPT2Block returns no per-layer `presents` tuple, so
+   the reference ModelBranch's `outputs[1]` under use_cache=True
+   (reference ppo_models.py:253) IndexErrors -> the harness sets
+   `frozen_head.config.use_cache = False` post-construction (the branch
+   consults its OWN config object; the trunk keeps use_cache=True, which
+   its 3-tuple unpack `logits, _, v` requires).
+5. The reference PPOPipeline hardcodes the IMDB download
+   (ppo_pipeline.py:23); zero-egress here -> LocalPromptPipeline keeps the
+   same PromptElement/PromptBatch contract with injected prompts.
+
+Verified against drift silently corrupting semantics: at construction the
+hydra branch's logits match the trunk's exactly (0.0 max abs diff) on the
+frozen model — the frozen-branch KL reference path is intact.
+"""
+
+import json
+import os
+import sys
+
+REFERENCE_ROOT = "/root/reference"
+
+# three-letter all-lowercase prompts: bos + 3 bytes == input_size 4
+PROMPTS = ["the", "cat", "dog", "run", "big", "sun", "sky", "box",
+           "ink", "joy", "key", "law", "map", "net", "owl", "pig"]
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_ROOT, "trlx"))
+
+
+def lowercase_reward(texts):
+    """Deterministic synthetic reward shared by both frameworks: fraction
+    of ASCII lowercase bytes in the sample text (special-token literals
+    stripped first — reference-side texts never decode them away)."""
+    out = []
+    for t in texts:
+        t = t.replace("<|endoftext|>", "")
+        b = t.encode("utf-8", errors="replace")
+        out.append(sum(1 for c in b if 97 <= c <= 122) / max(len(b), 1))
+    return out
+
+
+def install_shims():
+    import importlib.machinery
+    import types
+
+    import torch
+
+    if "wandb" not in sys.modules:
+        wandb = types.ModuleType("wandb")
+
+        class _Table:
+            def __init__(self, *a, **k):
+                self.args, self.kwargs = a, k
+
+        wandb.Table = _Table
+        wandb.watch = lambda *a, **k: None
+        wandb.init = lambda *a, **k: None
+        wandb.log = lambda *a, **k: None
+        wandb.__spec__ = importlib.machinery.ModuleSpec("wandb", loader=None)
+        sys.modules["wandb"] = wandb
+
+    if "torchtyping" not in sys.modules:
+        tt = types.ModuleType("torchtyping")
+
+        class _TensorType:
+            def __getitem__(self, item):
+                return torch.Tensor
+
+        tt.TensorType = _TensorType()
+        sys.modules["torchtyping"] = tt
+
+    import transformers
+
+    if not hasattr(transformers, "top_k_top_p_filtering"):
+        def top_k_top_p_filtering(
+            logits, top_k=0, top_p=1.0, filter_value=-float("inf"),
+            min_tokens_to_keep=1,
+        ):
+            if top_k > 0:
+                top_k = min(max(top_k, min_tokens_to_keep), logits.size(-1))
+                kth = torch.topk(logits, top_k)[0][..., -1, None]
+                logits = logits.masked_fill(logits < kth, filter_value)
+            if top_p < 1.0:
+                sorted_logits, sorted_idx = torch.sort(
+                    logits, descending=True
+                )
+                cum = torch.softmax(sorted_logits, dim=-1).cumsum(dim=-1)
+                remove = cum > top_p
+                remove[..., 1:] = remove[..., :-1].clone()
+                remove[..., :min_tokens_to_keep] = False
+                remove = remove.scatter(-1, sorted_idx, remove)
+                logits = logits.masked_fill(remove, filter_value)
+            return logits
+
+        transformers.top_k_top_p_filtering = top_k_top_p_filtering
+
+    import accelerate.tracking
+
+    accelerate.tracking.get_available_trackers = lambda: []
+
+
+def build_tiny_gpt2_checkpoint(out_dir, n_layer=2, n_embd=64, n_head=4,
+                               n_positions=64, seed=0):
+    """Byte-level GPT2 checkpoint + tokenizer, fully local (no hub).
+
+    The tokenizer is GPT2's own byte-level scheme with an empty merge
+    table: every string tokenizes to per-byte units, so a 257-entry vocab
+    covers all text and both frameworks share the exact id mapping."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel, GPT2Tokenizer
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    os.makedirs(out_dir, exist_ok=True)
+    b2u = bytes_to_unicode()
+    vocab = {ch: i for i, ch in enumerate(b2u.values())}
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(os.path.join(out_dir, "vocab.json"), "w") as f:
+        json.dump(vocab, f, ensure_ascii=False)
+    with open(os.path.join(out_dir, "merges.txt"), "w") as f:
+        f.write("#version: 0.2\n")
+    tok = GPT2Tokenizer(
+        os.path.join(out_dir, "vocab.json"),
+        os.path.join(out_dir, "merges.txt"),
+        bos_token="<|endoftext|>", eos_token="<|endoftext|>",
+        unk_token="<|endoftext|>",
+    )
+    tok.save_pretrained(out_dir)
+
+    torch.manual_seed(seed)
+    config = GPT2Config(
+        vocab_size=len(vocab), n_positions=n_positions, n_embd=n_embd,
+        n_layer=n_layer, n_head=n_head,
+        bos_token_id=vocab["<|endoftext|>"],
+        eos_token_id=vocab["<|endoftext|>"],
+    )
+    GPT2LMHeadModel(config).save_pretrained(out_dir)
+    return out_dir
+
+
+# Shared experiment shape. Reference AdamW defaults govern two values on
+# the trlx_tpu side: weight_decay=0.01 (reference passes none ->
+# torch.optim.AdamW default, accelerate_base_model.py:63) and NO gradient
+# clipping (the reference learn loop never clips).
+HPARAMS = dict(
+    num_layers_unfrozen=1, input_size=4, gen_size=8, batch_size=16,
+    total_steps=1024, learning_rate=1e-2, num_rollouts=128, chunk_size=32,
+    ppo_epochs=2, init_kl_coef=0.01, target=6.0, horizon=10000,
+    gamma=1.0, lam=0.95, cliprange=0.2, cliprange_value=0.2, vf_coef=1.0,
+)
+
+
+def reference_config_dict(ckpt, h=HPARAMS):
+    return {
+        "model": {
+            "model_path": ckpt, "tokenizer_path": ckpt,
+            "model_type": "AcceleratePPOModel", "device": "cpu",
+            "num_layers_unfrozen": h["num_layers_unfrozen"],
+        },
+        "train": {
+            "n_ctx": 64, "epochs": 0, "total_steps": h["total_steps"],
+            "batch_size": h["batch_size"], "grad_clip": 1.0,
+            "lr_ramp_steps": 0, "lr_decay_steps": h["total_steps"],
+            "weight_decay": 1e-6,
+            "learning_rate_init": h["learning_rate"],
+            "learning_rate_target": h["learning_rate"],
+            "log_interval": 10**9, "checkpoint_interval": 10**9,
+            "eval_interval": 10**9, "pipeline": "PPOPipeline",
+            "orchestrator": "PPOOrchestrator",
+            "input_size": h["input_size"], "gen_size": h["gen_size"],
+            "accelerate": True, "accelerate_config_path": "",
+        },
+        "method": {
+            "name": "ppoconfig", "num_rollouts": h["num_rollouts"],
+            "chunk_size": h["chunk_size"], "ppo_epochs": h["ppo_epochs"],
+            "init_kl_coef": h["init_kl_coef"], "target": h["target"],
+            "horizon": h["horizon"], "gamma": h["gamma"], "lam": h["lam"],
+            "cliprange": h["cliprange"],
+            "cliprange_value": h["cliprange_value"],
+            "vf_coef": h["vf_coef"],
+            "gen_kwargs": {
+                "max_length": h["input_size"] + h["gen_size"],
+                "min_length": h["input_size"] + h["gen_size"],
+                "top_k": 0, "top_p": 1.0, "do_sample": True,
+            },
+        },
+    }
+
+
+def run_reference_ppo(ckpt, workdir, h=HPARAMS):
+    """Drive the reference implementation end-to-end; returns the rollout
+    reward trajectory [{iter, mean_score, n}, ...] (one entry per
+    make_experience chunk, on-policy samples)."""
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    install_shims()
+
+    import torch
+    import yaml
+    from torch.utils.data import DataLoader
+
+    from trlx.data.accelerate_base_datatypes import (  # noqa: E501 (reference import)
+        PromptBatch,
+        PromptElement,
+    )
+    from trlx.data.configs import TRLConfig
+    from trlx.model.accelerate_ppo_model import AcceleratePPOModel
+    from trlx.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx.pipeline import BasePipeline
+
+    cfg_path = os.path.join(workdir, "ref_config.yml")
+    with open(cfg_path, "w") as f:
+        yaml.dump(reference_config_dict(ckpt, h), f)
+    config = TRLConfig.load_yaml(cfg_path)
+
+    class LocalPromptPipeline(BasePipeline):
+        """Reference PPOPipeline minus the hardcoded IMDB download: same
+        tokenize-up-front + PromptElement/PromptBatch contract
+        (reference ppo_pipeline.py:26-64), prompts injected."""
+
+        def __init__(self, prompts, tokenizer, config):
+            super().__init__()
+            self.tokens = [
+                tokenizer(
+                    tokenizer.bos_token + text,
+                    truncation=True, padding="max_length",
+                    max_length=config.train.input_size,
+                    return_tensors="pt",
+                )["input_ids"].long().flatten()
+                for text in prompts
+            ]
+            self.text = list(prompts)
+
+        def __getitem__(self, index):
+            return PromptElement(self.text[index], self.tokens[index])
+
+        def __len__(self):
+            return len(self.text)
+
+        def create_loader(self, batch_size, shuffle, prep_fn=None,
+                          num_workers=0):
+            def collate_fn(elems):
+                return PromptBatch(
+                    [e.text for e in elems],
+                    torch.stack([e.tokens for e in elems]),
+                )
+
+            return DataLoader(self, batch_size, shuffle,
+                              collate_fn=collate_fn,
+                              num_workers=num_workers)
+
+    trajectory = []
+    model = AcceleratePPOModel(config)
+    model.model.frozen_head.config.use_cache = False  # drift fix #4
+
+    def reward_fn(samples):
+        scores = lowercase_reward(samples)
+        trajectory.append({
+            "iter": int(getattr(model, "iter_count", 0)),
+            "mean_score": sum(scores) / len(scores), "n": len(scores),
+        })
+        return torch.tensor(scores)
+
+    pipeline = LocalPromptPipeline(PROMPTS, model.tokenizer, config)
+    orch = PPOOrchestrator(model, pipeline, reward_fn=reward_fn,
+                           chunk_size=config.method.chunk_size)
+    orch.make_experience(config.method.num_rollouts)
+    model.learn()
+    assert model.iter_count >= h["total_steps"]
+    return trajectory
+
+
+def trlx_tpu_config_dict(ckpt, h=HPARAMS):
+    return {
+        "model": {
+            "model_path": ckpt, "tokenizer_path": ckpt,
+            "model_type": "AcceleratePPOModel",
+            "num_layers_unfrozen": h["num_layers_unfrozen"],
+            "compute_dtype": "float32",
+        },
+        "train": {
+            "n_ctx": 64, "epochs": 10**6, "total_steps": h["total_steps"],
+            "batch_size": h["batch_size"], "grad_clip": 1e9,
+            "lr_ramp_steps": 0, "lr_decay_steps": h["total_steps"],
+            "weight_decay": 0.01,
+            "learning_rate_init": h["learning_rate"],
+            "learning_rate_target": h["learning_rate"],
+            "log_interval": 10**9, "checkpoint_interval": 10**9,
+            "eval_interval": 10**9, "pipeline": "PPOPipeline",
+            "orchestrator": "PPOOrchestrator",
+            "input_size": h["input_size"], "gen_size": h["gen_size"],
+            "seed": 0,
+        },
+        "method": {
+            "name": "ppoconfig", "num_rollouts": h["num_rollouts"],
+            "chunk_size": h["chunk_size"], "ppo_epochs": h["ppo_epochs"],
+            "init_kl_coef": h["init_kl_coef"], "target": h["target"],
+            "horizon": h["horizon"], "gamma": h["gamma"], "lam": h["lam"],
+            "cliprange": h["cliprange"],
+            "cliprange_value": h["cliprange_value"],
+            "vf_coef": h["vf_coef"],
+            "gen_kwargs": {
+                "max_length": h["input_size"] + h["gen_size"],
+                "min_length": h["input_size"] + h["gen_size"],
+                "top_k": 0, "top_p": 1.0, "do_sample": True,
+            },
+        },
+    }
+
+
+def run_trlx_tpu_ppo(ckpt, h=HPARAMS):
+    """trlx_tpu on the same checkpoint/task/hparams; same trajectory
+    format as run_reference_ppo."""
+    import numpy as np
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import (
+        get_model,
+        get_orchestrator,
+        get_pipeline,
+    )
+
+    config = TRLConfig.from_dict(trlx_tpu_config_dict(ckpt, h))
+    trainer = get_model(config.model.model_type)(config)
+    trajectory = []
+
+    def reward_fn(samples):
+        scores = lowercase_reward(samples)
+        trajectory.append({
+            "iter": int(getattr(trainer, "iter_count", 0)),
+            "mean_score": float(np.mean(scores)), "n": len(scores),
+        })
+        return np.asarray(scores, np.float32)
+
+    # bos prepended to mirror the reference's tokenize()
+    # (accelerate_base_model.py:95); x2 so the prompt bank covers a chunk
+    prompts = [trainer.tokenizer.bos_token + p for p in PROMPTS * 2]
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    orch.make_experience(config.method.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count >= h["total_steps"]
+    return trajectory

@@ -248,3 +248,21 @@ def test_per_device_nbytes_eager_vs_tracer():
 
     f(x)
     assert seen["val"] is None
+
+
+def test_decide_unroll_eager_and_env_override(monkeypatch):
+    """Trainers decide the decode unroll EAGERLY (code-review r05: inside
+    the jitted rollout the weights are tracers, so generate()'s own
+    per-device backoff can't engage) and pass it through; the env override
+    still governs the eager decision."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.configs import ModelSpec
+    from trlx_tpu.models.generation import decide_unroll
+
+    spec = ModelSpec(vocab_size=97, n_layer=2, n_head=2, d_model=32,
+                     n_positions=64)
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    assert decide_unroll(spec, params, batch_size=4, seq_len=16) is True
+    monkeypatch.setenv("TRLX_TPU_DECODE_UNROLL_MAX", "0")
+    assert decide_unroll(spec, params, batch_size=4, seq_len=16) is False

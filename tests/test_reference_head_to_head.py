@@ -1,0 +1,88 @@
+"""Behavioral head-to-head against the ACTUAL reference implementation.
+
+This is the one evidence class the golden-step tests can't provide: not a
+re-implementation of the reference's math as an oracle, but the reference
+codebase itself (torch + accelerate, /root/reference) trained on CPU and
+compared trajectory-to-trajectory with trlx_tpu from the SAME initial
+policy weights on the SAME task with the SAME hyperparameters.
+
+Setup (tests/reference_compat.py): a tiny local byte-level GPT2 checkpoint
+(2L/64d/257v) is saved to disk; the reference loads it through its own
+AcceleratePPOModel/PPOOrchestrator stack, trlx_tpu through its
+model_path import path. Both optimize the same deterministic reward
+(fraction of lowercase bytes) for 1024 optimizer steps. Value heads are
+each framework's own random init (the reference's make_head and our
+init_head_params are both fresh at construction); policy weights are
+bit-identical at start.
+
+Non-goals: step-for-step equality (sampling streams differ: torch RNG vs
+JAX rbg; the reference also trains wte/wpe — its freeze loop only covers
+bottom blocks, accelerate_base_model.py:38-41 — while our hydra split
+keeps embeddings frozen and lm_head trainable). The claim under test is
+behavioral: both frameworks LEARN the task from the same start, and
+trlx_tpu's final reward is matched-or-better.
+
+Writes HEADTOHEAD.json (both trajectories + summary) at the repo root.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.reference_compat import (
+    HPARAMS,
+    build_tiny_gpt2_checkpoint,
+    reference_available,
+    run_reference_ppo,
+    run_trlx_tpu_ppo,
+)
+
+pytestmark = pytest.mark.skipif(
+    not reference_available(), reason="/root/reference not present"
+)
+
+
+def _mean_last(traj, k=4):
+    return float(np.mean([t["mean_score"] for t in traj[-k:]]))
+
+
+def _mean_first(traj, k=4):
+    return float(np.mean([t["mean_score"] for t in traj[:k]]))
+
+
+def test_head_to_head_reward_trajectory(tmp_path):
+    ckpt = build_tiny_gpt2_checkpoint(str(tmp_path / "ckpt"))
+
+    ref_traj = run_reference_ppo(ckpt, str(tmp_path))
+    ours_traj = run_trlx_tpu_ppo(ckpt)
+
+    ref_start, ref_final = _mean_first(ref_traj), _mean_last(ref_traj)
+    ours_start, ours_final = _mean_first(ours_traj), _mean_last(ours_traj)
+
+    summary = {
+        "task": "lowercase-byte-fraction, 2L/64d byte-GPT2, "
+                f"{HPARAMS['total_steps']} steps",
+        "reference": {"start": ref_start, "final": ref_final},
+        "trlx_tpu": {"start": ours_start, "final": ours_final},
+    }
+    artifact = {
+        "summary": summary,
+        "hparams": HPARAMS,
+        "reference_trajectory": ref_traj,
+        "trlx_tpu_trajectory": ours_traj,
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "HEADTOHEAD.json"), "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    # same checkpoint, same on-policy metric: starting rewards agree
+    assert abs(ref_start - ours_start) < 0.05, summary
+    # the reference demonstrably learns on this rig (observed final 0.35
+    # and 0.47 on two runs — torch CPU sampling shifts with thread env,
+    # hence the loose floor)
+    assert ref_final - ref_start > 0.08, summary
+    # ours learns at least as much (observed 0.50 on both runs)
+    assert ours_final - ours_start > 0.10, summary
+    assert ours_final >= ref_final - 0.03, summary

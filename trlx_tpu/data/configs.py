@@ -215,6 +215,12 @@ class TrainConfig:
     # return cleanly (preemptible VMs / node drains), resumable via
     # resume_from (trlx_tpu.utils.preemption)
     save_on_preemption: bool = True
+    # multi-process runs agree on preemption via a small collective; it
+    # runs every this-many step boundaries. 0 = auto (min(log_interval, 8)
+    # — throttled for high-dispatch-latency runtimes while staying inside
+    # eviction grace windows). Lower it (e.g. 1) when single steps are
+    # slow enough that 8 of them outlast your scheduler's SIGTERM grace.
+    preempt_poll_interval: int = 0
     debug_nans: bool = False
 
     @classmethod

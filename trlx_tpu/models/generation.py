@@ -125,6 +125,36 @@ def _use_unrolled_layers(
     return True
 
 
+def decide_unroll(spec: ModelSpec, weight_params, batch_size: int,
+                  seq_len: int, cache_dtype=jnp.bfloat16) -> bool:
+    """Decode-unroll decision computed EAGERLY, for callers that jit
+    generate(): inside jit the weights are tracers with global shapes and
+    no shardings, so the per-device HBM backoff cannot engage at trace
+    time. Trainers call this once at build time on the concrete param
+    tree and pass the result through ``generate(..., unroll_layers=...)``.
+
+    `weight_params` may be the whole param tree — including branches
+    decode never touches (ref branch, value heads) — the slight
+    overestimate only errs toward the safer fori fallback. The cache
+    estimate stays global (unscaled by batch sharding): same direction."""
+    leaves = [
+        x for x in jax.tree_util.tree_leaves(weight_params)
+        if hasattr(x, "dtype")
+    ]
+    cache_bytes = (
+        2 * spec.n_layer * batch_size * seq_len * spec.kv_heads
+        * spec.head_dim * jnp.dtype(cache_dtype).itemsize
+    )
+    per_device = _per_device_nbytes(leaves)
+    if per_device is not None:
+        return _use_unrolled_layers(spec.n_layer,
+                                    per_device + 2 * cache_bytes)
+    return _use_unrolled_layers(
+        spec.n_layer, tree_bytes(leaves) + 2 * cache_bytes,
+        bytes_are_per_device=jax.device_count() == 1,
+    )
+
+
 def _sampling_key(rng: jax.Array) -> jax.Array:
     """The caller's PRNG key converted to the `rbg` implementation for the
     decode loop's per-step draws.
@@ -220,6 +250,7 @@ def generate(
     extras_fn: Optional[Callable] = None,
     attention_fn=attention_scores,
     logit_mask: Optional[jnp.ndarray] = None,
+    unroll_layers: Optional[bool] = None,
 ) -> GenerationOutput:
     """Sample `config.gen_size` tokens per row from a left-padded prompt.
 
@@ -277,28 +308,35 @@ def generate(
         2 * n_layers * B * S * spec.kv_heads * spec.head_dim
         * jnp.dtype(cache_dtype).itemsize
     )
-    weight_leaves = jax.tree_util.tree_leaves((blocks, embed))
-    per_device_weights = _per_device_nbytes(weight_leaves)
-    if per_device_weights is not None:
-        # Eager arrays: real per-device weight footprint (replicated params
-        # — e.g. pure dp — come out equal to global, so near-limit models
-        # still back off to fori). The cache is created inside this program
-        # and inherits the batch sharding; scale its estimate by the
-        # prompt's per-device batch fraction when that too is inspectable.
-        batch_scale = 1.0
-        per_device_prompt = _per_device_nbytes([prompt_tokens])
-        if per_device_prompt is not None and prompt_tokens.size:
-            batch_scale = per_device_prompt / (
-                prompt_tokens.size * prompt_tokens.dtype.itemsize
+    # `unroll_layers` not passed: decide here. Callers that jit this
+    # function should pass decide_unroll's eager verdict instead — under a
+    # jit trace the weights below are tracers and the per-device branch
+    # can't engage.
+    if unroll_layers is None:
+        weight_leaves = jax.tree_util.tree_leaves((blocks, embed))
+        per_device_weights = _per_device_nbytes(weight_leaves)
+        if per_device_weights is not None:
+            # Eager arrays: real per-device weight footprint (replicated
+            # params — e.g. pure dp — come out equal to global, so
+            # near-limit models still back off to fori). The cache is
+            # created inside this program and inherits the batch sharding;
+            # scale its estimate by the prompt's per-device batch fraction
+            # when that too is inspectable.
+            batch_scale = 1.0
+            per_device_prompt = _per_device_nbytes([prompt_tokens])
+            if per_device_prompt is not None and prompt_tokens.size:
+                batch_scale = per_device_prompt / (
+                    prompt_tokens.size * prompt_tokens.dtype.itemsize
+                )
+            unroll_layers = _use_unrolled_layers(
+                n_layers,
+                per_device_weights + 2 * int(cache_bytes * batch_scale),
             )
-        static_bytes = per_device_weights + 2 * int(cache_bytes * batch_scale)
-        unroll_layers = _use_unrolled_layers(n_layers, static_bytes)
-    else:
-        weight_bytes = tree_bytes(weight_leaves)
-        unroll_layers = _use_unrolled_layers(
-            n_layers, weight_bytes + 2 * cache_bytes,
-            bytes_are_per_device=jax.device_count() == 1,
-        )
+        else:
+            unroll_layers = _use_unrolled_layers(
+                n_layers, tree_bytes(weight_leaves) + 2 * cache_bytes,
+                bytes_are_per_device=jax.device_count() == 1,
+            )
 
     def run_layers(cache, h, bias, pos, offset):
         """One token through all blocks with IN-PLACE cache updates.

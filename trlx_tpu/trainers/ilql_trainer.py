@@ -27,7 +27,11 @@ import optax
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ilql_types import ILQLBatch
-from trlx_tpu.models.generation import GenerationConfig, generate
+from trlx_tpu.models.generation import (
+    GenerationConfig,
+    decide_unroll,
+    generate,
+)
 from trlx_tpu.models.hf_import import ilql_params_from_trunk
 from trlx_tpu.models.ilql import ILQLModel as ILQLNet, sync_targets
 from trlx_tpu.ops.losses import ilql_losses_chunked
@@ -158,6 +162,14 @@ class JaxILQLTrainer(BaseRLTrainer):
         temperature = m.temperature
         logit_mask = self.logit_mask
 
+        # eager unroll decision closed over the jitted closures (same
+        # rationale as the PPO trainer: tracers hide shardings); sized on
+        # the training batch — eval calls reuse it, close enough
+        unroll = decide_unroll(
+            net.spec, self.params, self.config.train.batch_size,
+            self.config.train.n_ctx,
+        )
+
         def generate_fn(params, query, query_mask, rng, gen_config):
             blocks = net.all_blocks(params)
             embed, ln_f = net.head_params_for_decode(params)
@@ -184,6 +196,7 @@ class JaxILQLTrainer(BaseRLTrainer):
             return generate(
                 net.spec, blocks, embed, ln_f, query, query_mask, rng,
                 gen_config, compute_dtype=net.compute_dtype, extras_fn=extras,
+                unroll_layers=unroll,
             )
 
         def train_step_indexed(params, opt_state, dataset: ILQLBatch, idx):
@@ -341,10 +354,12 @@ class JaxILQLTrainer(BaseRLTrainer):
 
         self.maybe_resume()  # no-op when already restored at construction
         # capped like the PPO loop: bounded detection latency vs eviction
-        # grace windows, 1/8th the per-step collective rate
+        # grace windows; train.preempt_poll_interval overrides
+        cfg = self.config.train
         with maybe_trace(), PreemptionGuard(
-            self.config.train.save_on_preemption,
-            poll_interval=min(self.config.train.log_interval, 8),
+            cfg.save_on_preemption,
+            poll_interval=(cfg.preempt_poll_interval
+                           or min(cfg.log_interval, 8)),
         ) as guard:
             self._learn_loop(log_fn, save_fn, eval_fn, guard)
 

@@ -30,7 +30,11 @@ import optax
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLBatch
-from trlx_tpu.models.generation import GenerationConfig, generate
+from trlx_tpu.models.generation import (
+    GenerationConfig,
+    decide_unroll,
+    generate,
+)
 from trlx_tpu.models.hf_import import hydra_params_from_trunk
 from trlx_tpu.models.policy import HydraPolicy
 from trlx_tpu.ops.losses import (
@@ -171,6 +175,13 @@ class JaxPPOTrainer(BaseRLTrainer):
         compute = DTYPES[self.config.model.compute_dtype]
 
         logit_mask = self.logit_mask
+        # decided EAGERLY on the concrete params (shardings visible) and
+        # closed over: inside the jitted rollout the weights are tracers
+        # and generate()'s own per-device HBM backoff cannot engage
+        unroll = decide_unroll(
+            policy.spec, self.params, m.chunk_size,
+            self.config.train.input_size + self.config.train.gen_size,
+        )
 
         def generate_fn(params, query, query_mask, rng):
             blocks = policy.all_blocks(params)
@@ -178,6 +189,7 @@ class JaxPPOTrainer(BaseRLTrainer):
             return generate(
                 policy.spec, blocks, embed, ln_f, query, query_mask, rng,
                 gen_config, compute_dtype=compute, logit_mask=logit_mask,
+                unroll_layers=unroll,
             )
 
         def score_fn(params, sequences, attention_mask, response_mask,
@@ -485,12 +497,14 @@ class JaxPPOTrainer(BaseRLTrainer):
         clock = Clock()
         self.maybe_resume()  # no-op when already restored at construction
 
-        # poll_interval is capped so preemption-detection latency stays
-        # bounded relative to eviction grace windows (a spot node gives
-        # ~30s): at 8 optimization batches the collective runs at 1/8 the
-        # per-step rate while worst-case detection lag stays a few seconds.
+        # auto poll_interval is capped so preemption-detection latency
+        # stays bounded relative to eviction grace windows (a spot node
+        # gives ~30s); train.preempt_poll_interval overrides for regimes
+        # where 8 steps outlast the grace period.
         with maybe_trace(), PreemptionGuard(
-            cfg.save_on_preemption, poll_interval=min(cfg.log_interval, 8)
+            cfg.save_on_preemption,
+            poll_interval=(cfg.preempt_poll_interval
+                           or min(cfg.log_interval, 8)),
         ) as guard:
             self._learn_loop(log_fn, cfg, m, clock, annotate, guard)
 
