@@ -54,7 +54,7 @@ def build_reward_model(config, trainer):
     )
     p = trainer.params
     embed = dict(p["frozen_base"]["embed"])
-    blocks = trainer.policy.all_blocks(p)  # bottom ++ top = full trunk
+    blocks = trainer.policy.all_blocks(p)  # (bottom, top) segment pair
     ln_f = p["trainable"]["ln_f"]
     # DeviceRewardModel deep-copies, decoupling the RM from the trainer's
     # donated buffers

@@ -270,3 +270,73 @@ def test_debug_nans_no_cross_trainer_leak():
         )
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_memory_fit_counts_optimizer_choice(monkeypatch):
+    """The precheck's optimizer-state term follows train.optimizer: the
+    bf16-frozen single-chip 6B hydra that FAILS under fp32 AdamW (~19 GB)
+    PASSES under adafactor (~15 GB) — the lever bench.py's 6B train leg
+    exercises on the real chip."""
+    import jax
+
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.data.configs import ModelSpec
+    from trlx_tpu.utils.loading import get_model
+
+    config = make_config(total_steps=2)
+    trainer = get_model(config.model.model_type)(config)
+    trainer.config.model.num_layers_unfrozen = 2
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 16 * 2**30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    gptj = ModelSpec.preset("gpt-j-6b")
+    with pytest.raises(ValueError, match="adafactor"):
+        trainer._check_memory_fit(gptj, jnp.bfloat16)
+    trainer.config.train.optimizer = "adafactor"
+    trainer._check_memory_fit(gptj, jnp.bfloat16)  # fits: no raise
+    # bf16 adam moments shave 2 bytes/param — still too big at 6B
+    trainer.config.train.optimizer = "adamw"
+    trainer.config.train.adam_moment_dtype = "bfloat16"
+    with pytest.raises(ValueError, match="HBM"):
+        trainer._check_memory_fit(gptj, jnp.bfloat16)
+
+
+def test_build_optimizer_variants_step():
+    """adafactor and bf16-mu adamw both produce valid updates on a tiny
+    param tree, and the adamw mu state is actually stored in bfloat16."""
+    import optax
+
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.trainers.ppo_trainer import build_optimizer
+
+    config = make_config(total_steps=2)
+    params = {"w": jnp.ones((4, 8), jnp.float32),
+              "b": jnp.zeros((8,), jnp.float32)}
+    grads = jax.tree_util.tree_map(lambda x: x + 0.1, params)
+
+    config.train.optimizer = "adamw"
+    config.train.adam_moment_dtype = "bfloat16"
+    opt = build_optimizer(config.train)
+    state = opt.init(params)
+    mus = [x.dtype for x in jax.tree_util.tree_leaves(state)
+           if hasattr(x, "dtype") and x.dtype == jnp.bfloat16]
+    assert mus, "no bfloat16 moment state found"
+    updates, _ = opt.update(grads, state, params)
+    stepped = optax.apply_updates(params, updates)
+    assert all(jnp.isfinite(x).all()
+               for x in jax.tree_util.tree_leaves(stepped))
+
+    config.train.optimizer = "adafactor"
+    opt = build_optimizer(config.train)
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    stepped = optax.apply_updates(params, updates)
+    assert all(jnp.isfinite(x).all()
+               for x in jax.tree_util.tree_leaves(stepped))
+
+    config.train.optimizer = "sgd"
+    with pytest.raises(ValueError, match="adamw, adafactor"):
+        build_optimizer(config.train)

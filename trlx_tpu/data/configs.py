@@ -214,6 +214,23 @@ class TrainConfig:
     # trap SIGTERM during learn(): checkpoint at the next step boundary and
     # return cleanly (preemptible VMs / node drains), resumable via
     # resume_from (trlx_tpu.utils.preemption)
+    # PPO only: dispatch the next epoch's rollout programs BEFORE the
+    # current epoch's updates drain (one host-sync saved per cycle — the
+    # dominant per-cycle cost on tunneled/remote runtimes). Semantics:
+    # each epoch trains on experience generated by the PREVIOUS epoch's
+    # policy (staleness of exactly one update phase) instead of the
+    # reference's strictly on-policy refresh. Default off = reference
+    # semantics.
+    continuous_rollouts: bool = False
+    # "adamw" (reference parity: torch AdamW, accelerate_base_model.py:63)
+    # or "adafactor" — the TPU-memory lever: factored second moment and no
+    # first moment drop optimizer state from 8 bytes/param to ~0, which is
+    # what fits 6B-class PPO on a single 16 GB chip
+    optimizer: str = "adamw"
+    # adamw first-moment (mu) storage dtype; "bfloat16" halves mu. The
+    # second moment stays float32 (optax exposes no nu dtype; its sqrt is
+    # precision-sensitive anyway)
+    adam_moment_dtype: str = "float32"
     save_on_preemption: bool = True
     # multi-process runs agree on preemption via a small collective; it
     # runs every this-many step boundaries. 0 = auto (min(log_interval, 8)

@@ -254,7 +254,12 @@ def generate(
 ) -> GenerationOutput:
     """Sample `config.gen_size` tokens per row from a left-padded prompt.
 
-    blocks: full stacked [L, ...] live-policy blocks; embed/ln_f: head params.
+    blocks: stacked [L, ...] live-policy blocks — either ONE stacked tree
+    or a tuple/list of stacked SEGMENTS run in order (the hydra policies
+    pass (frozen bottom, trainable top): concatenating them into one
+    stack inside a jitted program materializes a full copy of the trunk
+    as an HLO temp — ~10 GB at gpt-j-6B, the difference between fitting
+    and OOMing on one chip). embed/ln_f: head params.
     Everything inside is static-shape; wrap in jit (or pjit via the trainer).
 
     `logit_mask`: optional [V] (or [B, V]) boolean array; False entries are
@@ -271,7 +276,17 @@ def generate(
             f"prompt ({P}) + gen_size ({G}) = {S} exceeds the model's "
             f"n_positions ({spec.n_positions})"
         )
-    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    segments = tuple(blocks) if isinstance(blocks, (list, tuple)) \
+        else (blocks,)
+    seg_sizes = [
+        jax.tree_util.tree_leaves(s)[0].shape[0] for s in segments
+    ]
+    seg_starts = []
+    acc = 0
+    for size in seg_sizes:
+        seg_starts.append(acc)
+        acc += size
+    n_layers = acc
 
     rng = _sampling_key(rng)
     prompt_mask = prompt_mask.astype(jnp.int32)
@@ -290,10 +305,29 @@ def generate(
         ],
         axis=-1,
     )
-    h, cache = apply_blocks_with_cache(
-        blocks, cache, spec, h, prefill_bias, positions,
-        cache_offset=jnp.int32(0), attention_fn=attention_fn,
-    )
+    if len(segments) == 1:
+        h, cache = apply_blocks_with_cache(
+            segments[0], cache, spec, h, prefill_bias, positions,
+            cache_offset=jnp.int32(0), attention_fn=attention_fn,
+        )
+    else:
+        # per-segment prefill over the matching cache rows (static
+        # slices); the reassembled cache concat costs only cache bytes,
+        # never weight bytes
+        new_ks, new_vs = [], []
+        for seg, start, size in zip(segments, seg_starts, seg_sizes):
+            seg_cache = (
+                cache[0][start:start + size], cache[1][start:start + size]
+            )
+            h, (nk, nv) = apply_blocks_with_cache(
+                seg, seg_cache, spec, h, prefill_bias, positions,
+                cache_offset=jnp.int32(0), attention_fn=attention_fn,
+            )
+            new_ks.append(nk)
+            new_vs.append(nv)
+        cache = (
+            jnp.concatenate(new_ks, axis=0), jnp.concatenate(new_vs, axis=0)
+        )
     h_last = layer_norm(ln_f, h[:, -1:], spec.layer_norm_epsilon)
     logits0 = project_logits(embed, spec, h_last)[:, 0]  # [B, V]
 
@@ -346,35 +380,44 @@ def generate(
         leaves, so XLA aliases the update instead of re-materializing."""
         if unroll_layers:
             new_cache = []
-            for i in range(n_layers):
-                p_i = jax.tree_util.tree_map(lambda x: x[i], blocks)
-                h, kv = block_apply(
-                    spec, flags, p_i, h, bias, pos,
-                    kv_cache=cache[i], cache_offset=offset,
-                    attention_fn=attention_fn,
-                )
-                new_cache.append(kv)
+            layer = 0
+            for seg, size in zip(segments, seg_sizes):
+                for i in range(size):
+                    p_i = jax.tree_util.tree_map(lambda x: x[i], seg)
+                    h, kv = block_apply(
+                        spec, flags, p_i, h, bias, pos,
+                        kv_cache=cache[layer], cache_offset=offset,
+                        attention_fn=attention_fn,
+                    )
+                    new_cache.append(kv)
+                    layer += 1
             return tuple(new_cache), h
 
         k_c, v_c = cache
 
-        def layer_body(i, state):
-            h, k_c, v_c = state
-            p_i = jax.tree_util.tree_map(
-                lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, False), blocks
-            )
-            h, (k_new, v_new) = block_apply(
-                spec, flags, p_i, h, bias, pos,
-                kv_cache=(k_c[i], v_c[i]), cache_offset=offset,
-                attention_fn=attention_fn,
-            )
-            k_c = jax.lax.dynamic_update_index_in_dim(k_c, k_new, i, 0)
-            v_c = jax.lax.dynamic_update_index_in_dim(v_c, v_new, i, 0)
-            return (h, k_c, v_c)
+        # one fori_loop per segment (usually 1-2): weights index within
+        # the segment, the cache at the segment-offset global row
+        for seg, start, size in zip(segments, seg_starts, seg_sizes):
 
-        h, k_c, v_c = jax.lax.fori_loop(
-            0, n_layers, layer_body, (h, k_c, v_c)
-        )
+            def layer_body(i, state, seg=seg, start=start):
+                h, k_c, v_c = state
+                p_i = jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, False),
+                    seg,
+                )
+                g = i + start
+                h, (k_new, v_new) = block_apply(
+                    spec, flags, p_i, h, bias, pos,
+                    kv_cache=(k_c[g], v_c[g]), cache_offset=offset,
+                    attention_fn=attention_fn,
+                )
+                k_c = jax.lax.dynamic_update_index_in_dim(k_c, k_new, g, 0)
+                v_c = jax.lax.dynamic_update_index_in_dim(v_c, v_new, g, 0)
+                return (h, k_c, v_c)
+
+            h, k_c, v_c = jax.lax.fori_loop(
+                0, size, layer_body, (h, k_c, v_c)
+            )
         return (k_c, v_c), h
 
     def decode_body(carry, step):

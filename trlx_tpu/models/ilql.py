@@ -190,10 +190,11 @@ class ILQLModel:
         return tq, v
 
     def all_blocks(self, params: Params) -> Params:
-        bottom = params["frozen_base"]["blocks"]
-        top = params["trainable"]["blocks"]
-        return jax.tree_util.tree_map(
-            lambda a, b: jnp.concatenate([a, b], axis=0), bottom, top
+        """(bottom, trainable top) stacked-segment pair for the decode
+        engine — not concatenated, for the same jit-temp reason as
+        HydraPolicy.all_blocks."""
+        return (
+            params["frozen_base"]["blocks"], params["trainable"]["blocks"]
         )
 
     def head_params_for_decode(self, params: Params):

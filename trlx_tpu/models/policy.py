@@ -235,16 +235,20 @@ class HydraPolicy:
     # -- decode support -----------------------------------------------------
 
     def all_blocks(self, params: Params) -> Params:
-        """Bottom + trainable top stacked into one [L, ...] tree — the live
-        policy the decode engine runs. Under a mixed frozen_dtype the
-        trainable top is cast down to the frozen storage dtype (decode
-        computes in bf16 anyway)."""
+        """(bottom, trainable top) stacked-segment pair — the live policy
+        the decode engine runs in order. Deliberately NOT concatenated:
+        inside a jitted rollout the concat materializes a full copy of
+        the trunk as an HLO temp (~10 GB at gpt-j-6B — the single-chip
+        OOM bench_gptj6b_train hit); generate() consumes the segments
+        directly. Under a mixed frozen_dtype the trainable top is cast
+        down to the frozen storage dtype (decode computes in bf16
+        anyway)."""
         bottom = params["frozen_base"]["blocks"]
-        top = params["trainable"]["blocks"]
-        return jax.tree_util.tree_map(
-            lambda a, b: jnp.concatenate([a, b.astype(a.dtype)], axis=0),
-            bottom, top,
+        frozen_dtype = jax.tree_util.tree_leaves(bottom)[0].dtype
+        top = jax.tree_util.tree_map(
+            lambda b: b.astype(frozen_dtype), params["trainable"]["blocks"]
         )
+        return (bottom, top)
 
     def head_params_for_decode(self, params: Params) -> Tuple[Params, Params]:
         """(embed+lm_head dict, ln_f) for the live policy branch."""

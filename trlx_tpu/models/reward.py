@@ -63,7 +63,21 @@ class RewardModel:
     def from_trunk(self, embed: Params, blocks: Params, ln_f: Params,
                    head_rng: jax.Array, param_dtype=jnp.float32) -> Params:
         """Params from an imported pretrained trunk (hf_import layout) with
-        a fresh scalar head — how learned RMs are typically initialized."""
+        a fresh scalar head — how learned RMs are typically initialized.
+
+        `blocks` may be one stacked [L, ...] tree or a segment tuple (the
+        hydra policies' all_blocks shape). Segments are concatenated HERE,
+        eagerly, at construction: score() scans one stacked trunk, and an
+        eager concat costs one copy once — unlike inside a jitted program,
+        where it would re-materialize the trunk per trace (the gpt-j-6B
+        single-chip OOM generate() avoids)."""
+        if isinstance(blocks, (list, tuple)):
+            blocks = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(
+                    [x.astype(xs[0].dtype) for x in xs], axis=0
+                ),
+                *blocks,
+            )
         embed = dict(embed)
         embed.pop("lm_head", None)
         return {

@@ -17,9 +17,12 @@ TPU-first differences:
 - The prompt dataset is uploaded to the device once; per chunk the host
   sends only a [chunk_size] index array (same shuffled-without-replacement
   iteration order as the host loader it replaces).
-- Host scoring overlaps device work: the rollout for the next chunk is
-  dispatched (JAX async) before the host decodes/scores the current one.
+- Host scoring overlaps device work: every chunk's rollout program is
+  dispatched (JAX async) before the host decodes/scores the first one.
 - The KL controller updates from the measured per-chunk mean KL.
+- `start_experience` / `finish_experience` split the dispatch from the
+  harvest so the learn loop can overlap rollout generation with its own
+  update phase (train.continuous_rollouts).
 """
 
 from typing import Callable
@@ -104,7 +107,29 @@ class PPOOrchestrator(Orchestrator):
         Rollouts are produced in whole chunks (one fused device program
         each), so `num_rollouts` is rounded UP to a multiple of
         `chunk_size` — with a warning — and the returned info reports the
-        count actually produced."""
+        count actually produced.
+
+        Internally start_experience + finish_experience: the synchronous
+        on-policy path. The continuous-rollouts learn loop calls the two
+        halves around its update phase instead
+        (train.continuous_rollouts)."""
+        return self.finish_experience(
+            self.start_experience(num_rollouts, iter_count)
+        )
+
+    def start_experience(self, num_rollouts: int, iter_count: int = 0):
+        """Dispatch EVERY chunk's fused rollout program — no host sync —
+        against the policy params as of this call, returning a handle for
+        finish_experience.
+
+        All chunks dispatch up-front so one experience batch is generated
+        by ONE policy snapshot: under train.continuous_rollouts the learn
+        loop calls this BEFORE dispatching an epoch's updates, and a
+        lazy per-chunk dispatch would silently mix pre- and post-update
+        policies within the same batch. (JAX async dispatch: the device
+        executes these ahead of the later-enqueued update programs; the
+        outputs are small per-chunk tensors, so holding n_chunks of them
+        is cheap.)"""
         import warnings
 
         if num_rollouts <= 0:
@@ -122,15 +147,23 @@ class PPOOrchestrator(Orchestrator):
                 stacklevel=2,
             )
         bank_tokens, bank_mask = self._prompt_bank()
+        pendings = [
+            trainer.rollout(bank_tokens, bank_mask, self._next_idx())
+            for _ in range(n_chunks)
+        ]
+        return {"pendings": pendings, "n_chunks": n_chunks}
 
-        # dispatch the fused rollout for chunk 0; inside the loop, dispatch
-        # chunk i+1 before host-scoring chunk i so the device stays busy
-        # while the host runs reward_fn.
-        pending = trainer.rollout(bank_tokens, bank_mask, self._next_idx())
+    def finish_experience(self, handle):
+        """Harvest the rollouts start_experience dispatched: per chunk, ONE
+        (sequences, seq_kl[, device-RM scores]) fetch, host (or device-RM)
+        scoring, reward finalization riding the dispatch back, store push;
+        then the adaptive-KL update from the measured mean KL."""
+        trainer = self.rl_model
+        n_chunks = handle["n_chunks"]
 
         all_kls = []
         all_scores = []
-        for i in range(n_chunks):
+        for pending in handle["pendings"]:
             out, query, qmask, logprobs, values, kl_rewards, seq_kl = pending
 
             # a mesh-resident learned reward model scores the raw token
@@ -151,10 +184,6 @@ class PPOOrchestrator(Orchestrator):
                                                          rm_mask)
             else:
                 scores_dev = ()
-            if i + 1 < n_chunks:
-                pending = trainer.rollout(
-                    bank_tokens, bank_mask, self._next_idx()
-                )
 
             # THE one device->host fetch per chunk: only what the host
             # reward callback and the KL controller need. Everything

@@ -241,10 +241,21 @@ class BaseRLTrainer:
         # of the trainable budget and must not be omitted
         lm_head = 0 if spec.tie_lm_head else V * d
         frozen_sz = np.dtype(frozen_dtype).itemsize
+        # optimizer-state bytes/param follow train.optimizer — the lever
+        # build_optimizer documents: fp32 AdamW 8 (mu + nu), bf16-mu AdamW
+        # 6, adafactor ~0 (factored nu is O(rows + cols) per matrix)
+        opt_name = getattr(self.config.train, "optimizer", "adamw").lower()
+        if opt_name == "adafactor":
+            opt_bytes = 0
+        else:
+            mu_dtype = getattr(
+                self.config.train, "adam_moment_dtype", "float32"
+            )
+            opt_bytes = (2 if mu_dtype == "bfloat16" else 4) + 4
         est = (
             ((L - k) * per_layer + embed) * frozen_sz   # frozen trunk
             + (k * per_layer + lm_head) * frozen_sz * (1 if ref_branch else 0)
-            + (k * per_layer + lm_head + extra_trainable) * 4 * 3  # + 2 adam
+            + (k * per_layer + lm_head + extra_trainable) * (4 + opt_bytes)
             + extra_frozen * frozen_sz
         )
         shards = 1
@@ -262,12 +273,17 @@ class BaseRLTrainer:
                 "branch storage; trainable/optimizer stay fp32), "
                 if ref_branch else ""
             )
+            opt_hint = (
+                "set train.optimizer: adafactor (drops the "
+                f"{opt_bytes} optimizer bytes/param), "
+                if opt_bytes else ""
+            )
             raise ValueError(
                 f"model state needs ~{est / 2**30:.1f} GB/device but the "
                 f"device reports {limit / 2**30:.1f} GB HBM. Options: "
-                f"{dtype_opt}lower num_layers_unfrozen, shard over a mesh "
-                f"with fsdp/tp, or set TRLX_TPU_SKIP_MEMCHECK=1 to try "
-                f"anyway."
+                f"{dtype_opt}{opt_hint}lower num_layers_unfrozen, shard "
+                f"over a mesh with fsdp/tp, or set TRLX_TPU_SKIP_MEMCHECK=1 "
+                f"to try anyway."
             )
 
     def push_to_store(self, data) -> None:

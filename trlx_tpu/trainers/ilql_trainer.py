@@ -88,10 +88,9 @@ class JaxILQLTrainer(BaseRLTrainer):
             config.train.learning_rate_init,
             config.train.learning_rate_target,
         )
-        self.opt = optax.chain(
-            optax.clip_by_global_norm(config.train.grad_clip),
-            optax.adamw(sched, weight_decay=config.train.weight_decay),
-        )
+        from trlx_tpu.trainers.ppo_trainer import build_optimizer
+
+        self.opt = build_optimizer(config.train, sched=sched)
         self.params, self.opt_state = self._shard_model_state(
             self.params, self.opt
         )

@@ -55,18 +55,45 @@ from trlx_tpu.utils.trackers import generations_table, make_tracker
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
 
-def build_optimizer(train_config) -> optax.GradientTransformation:
-    """Grad-clip + AdamW + cosine anneal from lr_init to lr_target over
-    total_steps (reference: accelerate_base_model.py:63-70, with clip and
-    weight decay actually wired)."""
-    sched = cosine_schedule(
-        train_config.learning_rate_init,
-        train_config.total_steps,
-        lr_min=train_config.learning_rate_target,
-    )
+def build_optimizer(train_config, sched=None) -> optax.GradientTransformation:
+    """Grad-clip + configured optimizer + LR schedule (default: cosine
+    anneal from lr_init to lr_target over total_steps; the ILQL trainer
+    passes its ramp-up/decay schedule instead). Reference parity:
+    accelerate_base_model.py:63-70, with clip and weight decay actually
+    wired.
+
+    train.optimizer selects the state/memory tradeoff — "adamw" (default,
+    reference parity; train.adam_moment_dtype: bfloat16 halves the first
+    moment) or "adafactor" (factored second moment, no first moment:
+    optimizer state drops from 8 bytes/param to ~0, the lever that fits
+    6B-class PPO on one 16 GB chip). _check_memory_fit counts the same
+    choice."""
+    if sched is None:
+        sched = cosine_schedule(
+            train_config.learning_rate_init,
+            train_config.total_steps,
+            lr_min=train_config.learning_rate_target,
+        )
+    name = getattr(train_config, "optimizer", "adamw").lower()
+    if name == "adafactor":
+        opt = optax.adafactor(
+            learning_rate=sched,
+            weight_decay_rate=train_config.weight_decay or None,
+        )
+    elif name == "adamw":
+        opt = optax.adamw(
+            sched,
+            weight_decay=train_config.weight_decay,
+            mu_dtype=DTYPES[
+                getattr(train_config, "adam_moment_dtype", "float32")
+            ],
+        )
+    else:
+        raise ValueError(
+            f"train.optimizer '{name}' is not one of: adamw, adafactor"
+        )
     return optax.chain(
-        optax.clip_by_global_norm(train_config.grad_clip),
-        optax.adamw(sched, weight_decay=train_config.weight_decay),
+        optax.clip_by_global_norm(train_config.grad_clip), opt
     )
 
 
@@ -548,9 +575,35 @@ class JaxPPOTrainer(BaseRLTrainer):
 
         return iterator, run, lambda b: len(b.query_tensors)
 
+    def _will_refresh(self, cfg, m) -> bool:
+        """Whether the post-epoch experience refresh will run, PREDICTED
+        before the epoch's updates: the epoch advances iter_count by
+        exactly n_batches * ppo_epochs (both sides of the batch runner
+        drop the last partial batch), so the continuation condition is
+        computable up-front — which is what lets continuous mode dispatch
+        the next epoch's rollouts before this epoch's updates."""
+        if self.orch is None:
+            return False
+        n_batches = len(self.store) // cfg.batch_size
+        end_count = self.iter_count + n_batches * m.ppo_epochs
+        return end_count < cfg.total_steps and self.epoch + 1 < cfg.epochs
+
     def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None):
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
             loader, run, rows = self._batch_runner(cfg)
+            pending_exp = None
+            if cfg.continuous_rollouts and self._will_refresh(cfg, m):
+                # dispatch the NEXT epoch's rollout programs now, against
+                # the CURRENT (pre-update) params: the device runs them
+                # ahead of the update programs queued below, and the
+                # post-epoch harvest no longer waits for a
+                # rollout-after-update chain — one host sync saved per
+                # cycle. Cost: that experience is one update phase stale
+                # (train.continuous_rollouts docs).
+                with annotate("rollout_dispatch_stale"):
+                    pending_exp = self.orch.start_experience(
+                        m.num_rollouts, self.iter_count
+                    )
             for item in loader:
                 with annotate("ppo_update"):
                     # all ppo_epochs passes in ONE dispatch — per-dispatch
@@ -588,7 +641,17 @@ class JaxPPOTrainer(BaseRLTrainer):
             # post-epoch: refresh experience (reference
             # accelerate_ppo_model.py:122-128)
             self.epoch += 1
-            if self.orch is not None and self.iter_count < cfg.total_steps \
+            if pending_exp is not None:
+                # continuous mode: harvest the rollouts dispatched before
+                # this epoch's updates (a preemption mid-epoch above
+                # abandons them — the dispatched device work is moot)
+                self.store.clear_history()
+                with annotate("rollout_harvest"):
+                    info = self.orch.finish_experience(pending_exp)
+                log_fn({"iter": self.iter_count, "epoch": self.epoch, **info})
+                if self._preempt(log_fn, guard):
+                    return
+            elif self.orch is not None and self.iter_count < cfg.total_steps \
                     and self.epoch < cfg.epochs:
                 self.store.clear_history()
                 with annotate("rollout_refresh"):
