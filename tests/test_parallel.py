@@ -382,6 +382,13 @@ def test_broadcast_host_floats_uses_process0_when_multihost(monkeypatch):
     # every parameter sharded over all 8 devices: forwards/backwards
     # all-gather ACROSS the process boundary
     {"dp": 1, "fsdp": 8, "tp": 1, "sp": 1},
+    # Megatron tp collectives across the process boundary; the worker
+    # additionally asserts the sharded forward matches a dense local
+    # trainer's logits/values from identical init. (sp is not in the
+    # matrix: the 12-token test sequence doesn't divide by a
+    # process-spanning sp extent — ring attention is covered
+    # single-process in test_ring_attention.py.)
+    {"dp": 1, "fsdp": 1, "tp": 8, "sp": 1},
 ])
 def test_two_process_distributed_cpu(tmp_path, mesh_spec):
     """Bring up jax.distributed across TWO real processes (the multi-host
@@ -542,9 +549,116 @@ def test_pp_single_stage_passthrough(devices):
     )
 
 
-def test_trainer_rejects_pp_mesh(devices):
-    """pp is an op-level capability; a trainer config asking for pp > 1
-    must fail loudly instead of silently replicating work over the pp
-    slice."""
-    with pytest.raises(ValueError, match="pp"):
+def test_trainer_pp_uneven_trunk_fails_loudly(devices):
+    """Trainers CONSUME pp > 1 since round 5 — but a frozen trunk that
+    doesn't split into pp stages (here: the tiny 2-layer model leaves 1
+    frozen layer for pp=2) must fail at construction with the
+    stage-divisibility error, not a shape error three jit frames deep."""
+    with pytest.raises(ValueError, match="stages"):
         _tiny_trainer({"pp": 2, "dp": 4})
+
+
+# --------------------------------------------------------------------- #
+# pipeline parallelism consumed by the trainers (round 5)
+# --------------------------------------------------------------------- #
+
+
+def _pp_trainer(mesh_cfg, n_layer=3):
+    """3-layer model, 1 unfrozen top -> a 2-layer frozen trunk that splits
+    into pp=2 stages."""
+    config = make_config(num_layers_unfrozen=1, batch_size=16)
+    config.model.model_spec["n_layer"] = n_layer
+    config.train.mesh = mesh_cfg
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    return config, trainer
+
+
+def test_pp_trainer_train_step_matches_single_device(devices):
+    """train.mesh pp > 1 now drives the trainers' forward (VERDICT r04 #6):
+    the GPipe'd frozen trunk produces the same loss and updated params as
+    the dense single-device step."""
+    config_s, single = _pp_trainer(None)
+    batch = _rollout_batch(single, config_s)
+
+    config_m, meshed = _pp_trainer({"pp": 2, "dp": 2, "fsdp": 2})
+    assert meshed.policy.pp_mesh is not None
+
+    np.testing.assert_array_equal(
+        np.asarray(single.params["trainable"]["blocks"]["attn"]["wq"]),
+        np.asarray(meshed.params["trainable"]["blocks"]["attn"]["wq"]),
+    )
+    # the frozen trunk's layer axis is stage-sharded: each device holds
+    # L/pp layers — the parameter split pp exists for
+    wq_f = meshed.params["frozen_base"]["blocks"]["attn"]["wq"]
+    assert wq_f.sharding.spec[0] == "pp"
+    assert wq_f.addressable_shards[0].data.shape[0] == 1
+
+    p1, o1, stats1 = single._train_step(
+        single.params, single.opt_state,
+        jax.tree_util.tree_map(jnp.asarray, batch),
+    )
+    p2, o2, stats2 = meshed._train_step(
+        meshed.params, meshed.opt_state, shard_batch(meshed.mesh, batch)
+    )
+    np.testing.assert_allclose(
+        float(stats1["loss"]), float(stats2["loss"]), rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1["trainable"]["v_head"]["w2"]),
+        np.asarray(p2["trainable"]["v_head"]["w2"]),
+        rtol=2e-3, atol=2e-5,
+    )
+
+
+def test_pp_trainer_full_loop_runs(devices):
+    """make_experience + learn() under a pp mesh: rollout scoring and the
+    update both route the frozen trunk through the GPipe op."""
+    config, trainer = _pp_trainer({"pp": 2, "dp": 2, "fsdp": 2})
+    config.train.total_steps = 4
+    config.train.epochs = 2
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    orch.make_experience(config.method.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count == 4
+
+
+def test_pp_rejects_uneven_stage_split(devices):
+    with pytest.raises(ValueError, match="stages"):
+        _pp_trainer({"pp": 2, "dp": 2, "fsdp": 2}, n_layer=2)
+
+
+def test_pp_rejects_sp_combination(devices):
+    config = make_config(num_layers_unfrozen=1)
+    config.model.model_spec["n_layer"] = 3
+    config.train.mesh = {"pp": 2, "sp": 2, "dp": 2}
+    with pytest.raises(ValueError, match="sp"):
+        get_model(config.model.model_type)(config)
+
+
+def test_relayout_for_decode_is_noop_on_cpu(devices):
+    """On the CPU backend relayout_for_decode must return the tree
+    UNTOUCHED — CPU accepts custom layouts but mishandles them downstream
+    (an Orbax round trip of relayouted params came back with transposed
+    values), so the gate is itself the contract under test. The TPU-side
+    value-preservation property is exercised on hardware by the 6B bench
+    leg (bench_gptj6b_train learns with relayouted params) — it cannot be
+    asserted here without the buggy CPU layout path."""
+    from trlx_tpu.parallel import relayout_for_decode
+
+    config, trainer = _tiny_trainer()
+    wq_before = trainer.params["frozen_base"]["blocks"]["attn"]["wq"]
+    after_params = relayout_for_decode(trainer.params)
+    # identical OBJECTS: no relayout, no donation, nothing invalidated
+    assert after_params["frozen_base"]["blocks"]["attn"]["wq"] is wq_before
+    assert after_params["trainable"] is trainer.params["trainable"]
+    np.testing.assert_array_equal(
+        np.asarray(wq_before),
+        np.asarray(after_params["frozen_base"]["blocks"]["attn"]["wq"]),
+    )

@@ -205,15 +205,15 @@ class TrainConfig:
     tracker: str = "print"
 
     mesh: Optional[Dict[str, int]] = None
+    # microbatches per GPipe pass when mesh.pp > 1 (bubble fraction is
+    # (pp-1)/(n_micro+pp-1): raise toward 4*pp to amortize)
+    pp_num_microbatches: int = 4
     seed: int = 0
     remat: bool = False
     checkpoint_dir: str = "ckpts"
     # restore components from this checkpoint directory at the start of the
     # first learn() call (kill-and-continue resume); "" disables
     resume_from: str = ""
-    # trap SIGTERM during learn(): checkpoint at the next step boundary and
-    # return cleanly (preemptible VMs / node drains), resumable via
-    # resume_from (trlx_tpu.utils.preemption)
     # PPO only: dispatch the next epoch's rollout programs BEFORE the
     # current epoch's updates drain (one host-sync saved per cycle — the
     # dominant per-cycle cost on tunneled/remote runtimes). Semantics:
@@ -231,6 +231,9 @@ class TrainConfig:
     # second moment stays float32 (optax exposes no nu dtype; its sqrt is
     # precision-sensitive anyway)
     adam_moment_dtype: str = "float32"
+    # trap SIGTERM during learn(): checkpoint at the next step boundary and
+    # return cleanly (preemptible VMs / node drains), resumable via
+    # resume_from (trlx_tpu.utils.preemption)
     save_on_preemption: bool = True
     # multi-process runs agree on preemption via a small collective; it
     # runs every this-many step boundaries. 0 = auto (min(log_interval, 8)

@@ -48,6 +48,9 @@ class ILQLModel:
     compute_dtype: Any = jnp.bfloat16
     remat: bool = False
     attention_fn: Any = None
+    # GPipe for the frozen trunk, same contract as HydraPolicy.pp_mesh
+    pp_mesh: Any = None
+    pp_n_micro: int = 4
 
     @property
     def k(self) -> int:
@@ -55,6 +58,12 @@ class ILQLModel:
 
     def _attn(self):
         return self.attention_fn or attention_scores
+
+    def _pp_active(self) -> bool:
+        return (
+            self.pp_mesh is not None
+            and self.pp_mesh.shape.get("pp", 1) > 1
+        )
 
     # -- init ---------------------------------------------------------------
 
@@ -138,10 +147,19 @@ class ILQLModel:
             params["frozen_base"]["embed"], spec, tokens, positions,
             self.compute_dtype,
         )
-        h = apply_blocks(
-            params["frozen_base"]["blocks"], spec, h, mask_bias, positions,
-            remat=self.remat, attention_fn=self._attn(),
-        )
+        if self._pp_active():
+            from trlx_tpu.ops.pipeline_parallel import pp_apply_blocks
+
+            h = pp_apply_blocks(
+                self.pp_mesh, params["frozen_base"]["blocks"], spec, h,
+                mask_bias, positions, n_micro=self.pp_n_micro,
+                attention_fn=self._attn(),
+            )
+        else:
+            h = apply_blocks(
+                params["frozen_base"]["blocks"], spec, h, mask_bias,
+                positions, remat=self.remat, attention_fn=self._attn(),
+            )
         h = apply_blocks(
             params["trainable"]["blocks"], spec, h, mask_bias, positions,
             remat=self.remat, attention_fn=self._attn(),

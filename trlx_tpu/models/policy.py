@@ -65,6 +65,13 @@ class HydraPolicy:
     compute_dtype: Any = jnp.bfloat16
     remat: bool = False
     attention_fn: Any = None  # None => plain XLA attention
+    # GPipe over the mesh's pp axis for the FROZEN TRUNK (the bulk of the
+    # layers — what pp exists to fit): set by the trainers when
+    # train.mesh has pp > 1. The small trainable/ref tops stay dense and
+    # dp/fsdp/tp-sharded as usual. jax.sharding.Mesh is hashable, so the
+    # dataclass stays a valid jit-cache key.
+    pp_mesh: Any = None
+    pp_n_micro: int = 4
 
     @property
     def k(self) -> int:
@@ -72,6 +79,12 @@ class HydraPolicy:
 
     def _attn(self):
         return self.attention_fn or attention_scores
+
+    def _pp_active(self) -> bool:
+        return (
+            self.pp_mesh is not None
+            and self.pp_mesh.shape.get("pp", 1) > 1
+        )
 
     # -- init ---------------------------------------------------------------
 
@@ -141,15 +154,26 @@ class HydraPolicy:
             positions,
             self.compute_dtype,
         )
-        h = apply_blocks(
-            params["frozen_base"]["blocks"],
-            self.spec,
-            h,
-            mask_bias,
-            positions,
-            remat=self.remat,
-            attention_fn=self._attn(),
-        )
+        if self._pp_active():
+            from trlx_tpu.ops.pipeline_parallel import pp_apply_blocks
+
+            # GPipe the frozen trunk (pp_apply_blocks remats its tick
+            # internally, so `remat` is subsumed)
+            h = pp_apply_blocks(
+                self.pp_mesh, params["frozen_base"]["blocks"], self.spec,
+                h, mask_bias, positions, n_micro=self.pp_n_micro,
+                attention_fn=self._attn(),
+            )
+        else:
+            h = apply_blocks(
+                params["frozen_base"]["blocks"],
+                self.spec,
+                h,
+                mask_bias,
+                positions,
+                remat=self.remat,
+                attention_fn=self._attn(),
+            )
         return h, mask_bias, positions
 
     def _branch_hidden(self, branch: Params, h, mask_bias, positions):

@@ -37,6 +37,7 @@ from trlx_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_sharding_specs,
     param_shardings,
+    relayout_for_decode,
     replicated,
     shard_batch,
     shard_params,

@@ -69,18 +69,40 @@ class BaseRLTrainer:
         initialize_runtime()
         # mesh: explicit > config (TrainConfig.mesh) > None (single device)
         self.mesh = mesh if mesh is not None else mesh_from_config(config.train)
-        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
-            # pp is an op-level capability today (trlx_tpu.ops.
-            # pipeline_parallel, numerically verified); the trainers'
-            # forward paths do not pipeline yet, so pp > 1 here would
-            # silently replicate work across a whole device slice
-            raise ValueError(
-                "train.mesh pp > 1 is not consumed by the trainers yet — "
-                "the GPipe op lives in trlx_tpu.ops.pipeline_parallel; "
-                "use dp/fsdp/tp/sp in train.mesh"
-            )
 
     # -- SPMD helpers (shared by all trainers) --------------------------- #
+
+    def _pp_kwargs(self, n_bottom_layers: int, *batch_sizes) -> Dict:
+        """Policy-dataclass kwargs that turn on GPipe for the frozen trunk
+        when train.mesh has pp > 1 (trlx_tpu.ops.pipeline_parallel),
+        validated up-front: the frozen layer count must split evenly into
+        stages and every batch the forward sees must split into
+        microbatches — a config error here beats a shape error three jit
+        frames deep."""
+        if self.mesh is None or self.mesh.shape.get("pp", 1) <= 1:
+            return {}
+        pp = self.mesh.shape["pp"]
+        if self.mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "train.mesh pp > 1 cannot combine with sp > 1: ring "
+                "attention runs its own shard_map over sp, which cannot "
+                "nest inside the GPipe stage shard_map"
+            )
+        n_micro = self.config.train.pp_num_microbatches
+        if n_bottom_layers % pp:
+            raise ValueError(
+                f"pipeline parallelism: the frozen trunk has "
+                f"{n_bottom_layers} layers, not divisible into pp={pp} "
+                f"stages; adjust num_layers_unfrozen or the pp extent"
+            )
+        for b in batch_sizes:
+            if b % n_micro:
+                raise ValueError(
+                    f"pipeline parallelism: batch of {b} rows is not "
+                    f"divisible into train.pp_num_microbatches={n_micro} "
+                    f"microbatches"
+                )
+        return {"pp_mesh": self.mesh, "pp_n_micro": n_micro}
 
     def _shard_model_state(self, params, opt):
         """(sharded params, sharded opt state) under the framework specs

@@ -34,10 +34,12 @@ from trlx_tpu.models.generation import (
 )
 from trlx_tpu.models.hf_import import ilql_params_from_trunk
 from trlx_tpu.models.ilql import ILQLModel as ILQLNet, sync_targets
+from trlx_tpu.models.policy import resolve_num_unfrozen
 from trlx_tpu.ops.losses import ilql_losses_chunked
 from trlx_tpu.ops.sampling import SamplingParams, warp_top_k
 from trlx_tpu.trainers import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import Clock, rampup_decay_schedule
+from trlx_tpu.utils.aotjit import aot_jit, formats_of
 from trlx_tpu.utils.tokenizer import load_tokenizer
 from trlx_tpu.utils.trackers import make_tracker, samples_table
 
@@ -76,6 +78,13 @@ class JaxILQLTrainer(BaseRLTrainer):
             compute_dtype=DTYPES[config.model.compute_dtype],
             remat=config.train.remat,
             attention_fn=self._train_attention_fn(),
+            **self._pp_kwargs(
+                spec.n_layer
+                - resolve_num_unfrozen(
+                    spec, config.model.num_layers_unfrozen
+                ),
+                config.train.batch_size,
+            ),
         )
         if trunk is not None:
             self.params = ilql_params_from_trunk(self.net, *trunk, init_rng)
@@ -94,6 +103,11 @@ class JaxILQLTrainer(BaseRLTrainer):
         self.params, self.opt_state = self._shard_model_state(
             self.params, self.opt
         )
+        # decode-preferred at-rest layout for the frozen attention stacks
+        # (see trlx_tpu.parallel.relayout_for_decode)
+        from trlx_tpu.parallel import relayout_for_decode
+
+        self.params = relayout_for_decode(self.params)
 
         # [V] or [V, V] boolean; True = DISALLOWED (the reference passes the
         # adjacency complement, examples/ilql_randomwalks.py:72)
@@ -207,11 +221,23 @@ class JaxILQLTrainer(BaseRLTrainer):
             batch = jax.tree_util.tree_map(lambda x: x[idx], dataset)
             return train_step(params, opt_state, batch)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
-        self._train_step_indexed = jax.jit(
-            train_step_indexed, donate_argnums=(0, 1)
+        # aot_jit + pinned params-output formats: custom at-rest layouts
+        # survive only the AOT compile path, and the donated update must
+        # re-emit them or the next decode recompiles for default layouts
+        # (see the PPO trainer's identical note)
+        params_fmt = formats_of(self.params)
+        opt_fmt = formats_of(self.opt_state)
+        self._train_step = aot_jit(
+            train_step, donate_argnums=(0, 1),
+            out_shardings=(params_fmt, opt_fmt, None),
         )
-        self._sync = jax.jit(lambda p: sync_targets(p, m.alpha))
+        self._train_step_indexed = aot_jit(
+            train_step_indexed, donate_argnums=(0, 1),
+            out_shardings=(params_fmt, opt_fmt, None),
+        )
+        self._sync = aot_jit(
+            lambda p: sync_targets(p, m.alpha), out_shardings=params_fmt
+        )
         self._generate_fn = generate_fn
         self._generate_jitted = {}
 
@@ -234,7 +260,7 @@ class JaxILQLTrainer(BaseRLTrainer):
                 eos_token_id=eos,
                 pad_token_id=eos,
             )
-            self._generate_jitted[key] = jax.jit(
+            self._generate_jitted[key] = aot_jit(
                 lambda p, q, m, r: self._generate_fn(p, q, m, r, gen_config)
             )
         (query, mask), n = self._pad_rows(

@@ -36,7 +36,7 @@ from trlx_tpu.models.generation import (
     generate,
 )
 from trlx_tpu.models.hf_import import hydra_params_from_trunk
-from trlx_tpu.models.policy import HydraPolicy
+from trlx_tpu.models.policy import HydraPolicy, resolve_num_unfrozen
 from trlx_tpu.ops.losses import (
     chunked_label_logprobs,
     gae_advantages,
@@ -49,6 +49,7 @@ from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainers import BaseRLTrainer, register_trainer
 from trlx_tpu.trainers.kl_controllers import make_kl_controller
 from trlx_tpu.utils import Clock, cosine_schedule
+from trlx_tpu.utils.aotjit import aot_jit, formats_of
 from trlx_tpu.utils.tokenizer import load_tokenizer
 from trlx_tpu.utils.trackers import generations_table, make_tracker
 
@@ -129,12 +130,19 @@ class JaxPPOTrainer(BaseRLTrainer):
                     f"= {T}) to be divisible by it (ring attention splits "
                     f"the train-time sequence across sp devices)"
                 )
+        k = resolve_num_unfrozen(spec, config.model.num_layers_unfrozen)
         self.policy = HydraPolicy(
             spec=spec,
             num_layers_unfrozen=config.model.num_layers_unfrozen,
             compute_dtype=compute_dtype,
             remat=config.train.remat,
             attention_fn=self._train_attention_fn(),
+            # every forward this policy runs: train batches + rollout
+            # scoring chunks + eval chunks (eval reuses chunk_size)
+            **self._pp_kwargs(
+                spec.n_layer - k, config.train.batch_size,
+                config.method.chunk_size,
+            ),
         )
         # param_dtype applies to the FROZEN trunk + reference branch only;
         # the trainable branch and its optimizer state stay float32 (the
@@ -156,6 +164,12 @@ class JaxPPOTrainer(BaseRLTrainer):
         self.params, self.opt_state = self._shard_model_state(
             self.params, self.opt
         )
+        # decode-preferred at-rest layout for the frozen attention stacks:
+        # removes the rollout program's full-stack layout-copy temps
+        # (~2.5 GB at gpt-j-6B — see relayout_for_decode)
+        from trlx_tpu.parallel import relayout_for_decode
+
+        self.params = relayout_for_decode(self.params)
 
         # --- rollout machinery --------------------------------------------
         self.store = PPORolloutStorage()
@@ -363,13 +377,31 @@ class JaxPPOTrainer(BaseRLTrainer):
             batch = jax.tree_util.tree_map(lambda x: x[idx], store_batch)
             return train_multi(params, opt_state, batch)
 
-        self._generate_fn = jax.jit(generate_fn)
-        self._rollout_fn = jax.jit(rollout_fn, static_argnames=())
+        # aot_jit (not jax.jit): the params carry custom at-rest layouts
+        # (relayout_for_decode) that only the AOT compile path preserves —
+        # plain jit would re-layout them every dispatch and re-materialize
+        # the decode layout-copy temps (trlx_tpu.utils.aotjit). The train
+        # steps additionally pin their params OUTPUT to the input formats:
+        # without that, the donated update emits default-layout frozen
+        # leaves, and the NEXT cycle's rollout recompiles for default
+        # layouts — resurrecting the copies (observed: a 6B second-cycle
+        # OOM after a clean first cycle).
+        params_fmt = formats_of(self.params)
+        opt_fmt = formats_of(self.opt_state)
+        self._generate_fn = aot_jit(generate_fn)
+        self._rollout_fn = aot_jit(rollout_fn)
         self._finalize_rewards = jax.jit(finalize_rewards)
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
-        self._train_multi = jax.jit(train_multi, donate_argnums=(0, 1))
-        self._train_multi_indexed = jax.jit(
-            train_multi_indexed, donate_argnums=(0, 1)
+        self._train_step = aot_jit(
+            train_step, donate_argnums=(0, 1),
+            out_shardings=(params_fmt, opt_fmt, None),
+        )
+        self._train_multi = aot_jit(
+            train_multi, donate_argnums=(0, 1),
+            out_shardings=(params_fmt, opt_fmt, None),
+        )
+        self._train_multi_indexed = aot_jit(
+            train_multi_indexed, donate_argnums=(0, 1),
+            out_shardings=(params_fmt, opt_fmt, None),
         )
 
     # -- BaseRLTrainer surface ------------------------------------------ #
