@@ -281,19 +281,20 @@ def generate(
     seg_sizes = [
         jax.tree_util.tree_leaves(s)[0].shape[0] for s in segments
     ]
-    seg_starts = []
-    acc = 0
-    for size in seg_sizes:
-        seg_starts.append(acc)
-        acc += size
-    n_layers = acc
+    n_layers = sum(seg_sizes)
 
     rng = _sampling_key(rng)
     prompt_mask = prompt_mask.astype(jnp.int32)
     real_len = prompt_mask.sum(axis=-1)  # [B]
 
     # --- prefill ---------------------------------------------------------
-    cache = init_kv_cache(spec, n_layers, B, S, cache_dtype)
+    # the KV cache is a LIST of per-segment stacked (k, v) buffers —
+    # never one concatenated [L, ...] stack: re-assembling segment slices
+    # costs a full cache copy in HLO temps per program (~2 GB at gpt2-xl
+    # b128), for buffers only this function ever reads
+    cache_segs = [
+        init_kv_cache(spec, size, B, S, cache_dtype) for size in seg_sizes
+    ]
     positions = positions_from_mask(prompt_mask)
     h = embed_tokens(embed, spec, prompt_tokens, positions, compute_dtype)
     # [B, 1, P, S] bias: causal over prompt slots, pad keys excluded, future
@@ -305,28 +306,10 @@ def generate(
         ],
         axis=-1,
     )
-    if len(segments) == 1:
-        h, cache = apply_blocks_with_cache(
-            segments[0], cache, spec, h, prefill_bias, positions,
+    for i, seg in enumerate(segments):
+        h, cache_segs[i] = apply_blocks_with_cache(
+            seg, cache_segs[i], spec, h, prefill_bias, positions,
             cache_offset=jnp.int32(0), attention_fn=attention_fn,
-        )
-    else:
-        # per-segment prefill over the matching cache rows (static
-        # slices); the reassembled cache concat costs only cache bytes,
-        # never weight bytes
-        new_ks, new_vs = [], []
-        for seg, start, size in zip(segments, seg_starts, seg_sizes):
-            seg_cache = (
-                cache[0][start:start + size], cache[1][start:start + size]
-            )
-            h, (nk, nv) = apply_blocks_with_cache(
-                seg, seg_cache, spec, h, prefill_bias, positions,
-                cache_offset=jnp.int32(0), attention_fn=attention_fn,
-            )
-            new_ks.append(nk)
-            new_vs.append(nv)
-        cache = (
-            jnp.concatenate(new_ks, axis=0), jnp.concatenate(new_vs, axis=0)
         )
     h_last = layer_norm(ln_f, h[:, -1:], spec.layer_norm_epsilon)
     logits0 = project_logits(embed, spec, h_last)[:, 0]  # [B, V]
@@ -379,6 +362,8 @@ def generate(
         or the stacked (k, v) buffers (fori path) — both are scan-carry
         leaves, so XLA aliases the update instead of re-materializing."""
         if unroll_layers:
+            # cache: flat tuple of per-layer (k, v) pairs (scan-carry
+            # leaves, aliased in place)
             new_cache = []
             layer = 0
             for seg, size in zip(segments, seg_sizes):
@@ -393,32 +378,32 @@ def generate(
                     layer += 1
             return tuple(new_cache), h
 
-        k_c, v_c = cache
+        # fori path: cache is a tuple of per-segment stacked (k, v)
+        # buffers; one fori_loop per segment (usually 1-2) with LOCAL
+        # indices on its own buffers
+        new_cache = []
+        for seg, size, (k_c, v_c) in zip(segments, seg_sizes, cache):
 
-        # one fori_loop per segment (usually 1-2): weights index within
-        # the segment, the cache at the segment-offset global row
-        for seg, start, size in zip(segments, seg_starts, seg_sizes):
-
-            def layer_body(i, state, seg=seg, start=start):
+            def layer_body(i, state, seg=seg):
                 h, k_c, v_c = state
                 p_i = jax.tree_util.tree_map(
                     lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, False),
                     seg,
                 )
-                g = i + start
                 h, (k_new, v_new) = block_apply(
                     spec, flags, p_i, h, bias, pos,
-                    kv_cache=(k_c[g], v_c[g]), cache_offset=offset,
+                    kv_cache=(k_c[i], v_c[i]), cache_offset=offset,
                     attention_fn=attention_fn,
                 )
-                k_c = jax.lax.dynamic_update_index_in_dim(k_c, k_new, g, 0)
-                v_c = jax.lax.dynamic_update_index_in_dim(v_c, v_new, g, 0)
+                k_c = jax.lax.dynamic_update_index_in_dim(k_c, k_new, i, 0)
+                v_c = jax.lax.dynamic_update_index_in_dim(v_c, v_new, i, 0)
                 return (h, k_c, v_c)
 
             h, k_c, v_c = jax.lax.fori_loop(
                 0, size, layer_body, (h, k_c, v_c)
             )
-        return (k_c, v_c), h
+            new_cache.append((k_c, v_c))
+        return tuple(new_cache), h
 
     def decode_body(carry, step):
         cache, logits, h_prev_normed, prev_tok, finished, rng = carry
@@ -465,12 +450,15 @@ def generate(
         return carry, (tok, logprob, emitted_mask)
 
     if unroll_layers:
-        # stacked [L, ...] prefill buffers -> per-layer carry leaves
+        # stacked per-segment prefill buffers -> flat per-layer carry
+        # leaves
         decode_cache = tuple(
-            (cache[0][i], cache[1][i]) for i in range(n_layers)
+            (k[i], v[i])
+            for (k, v), size in zip(cache_segs, seg_sizes)
+            for i in range(size)
         )
     else:
-        decode_cache = cache
+        decode_cache = tuple(cache_segs)
     h0_normed = h_last[:, 0]
     finished0 = jnp.zeros((B,), bool)
     # last real prompt token per row (left padding aware)

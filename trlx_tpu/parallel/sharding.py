@@ -226,7 +226,8 @@ def shard_batch(mesh: Mesh, tree):
     return jax.device_put(tree, batch_sharding(mesh))
 
 
-def relayout_for_decode(params: Params) -> Params:
+def relayout_for_decode(params: Params,
+                        min_bytes: int = 2 << 30) -> Params:
     """Frozen-trunk attention projections (wq/wk/wv) moved to the
     transposed at-rest layout (major_to_minor (0, 2, 1)) the decode
     matvecs want.
@@ -241,14 +242,20 @@ def relayout_for_decode(params: Params) -> Params:
     practice it's free. Decode throughput also gains: the per-program
     copies are re-materialized HBM traffic on every rollout dispatch.
 
-    jit consumes custom-layout args directly (the layout joins the
-    compile signature); donated train steps pass the frozen subtree
-    through unchanged, so the layout survives updates. Checkpoint
-    restore rebuilds default layouts — callers re-apply after a
-    restore if they care. DONATES the source stacks (the caller's input
-    tree must be re-bound from the return value); degrades gracefully —
-    with a warning — when the runtime rejects the relayout, keeping
-    whatever moved."""
+    Only the AOT compile path honors custom layouts, and its
+    Compiled.call dispatch skips jit's C++ fastpath — ~seconds per
+    dispatch on tunneled runtimes. That trade only pays when the copies
+    rival HBM headroom, so the pass is SIZE-GATED: a no-op (same object
+    returned — callers key the aot_jit decision on identity) unless the
+    target stacks total at least `min_bytes` (default 2 GiB: gpt-j-6B's
+    2.6 GB qualifies; gpt2-xl's 1.4 GB and the 124M headline stay on
+    default layouts + fast jit dispatch). Donated train steps pass the
+    frozen subtree through unchanged, so the layout survives updates.
+    Checkpoint restore rebuilds default layouts — callers re-apply after
+    a restore if they care. DONATES the source stacks (the caller's
+    input tree must be re-bound from the return value); degrades
+    gracefully — with a warning — when the runtime rejects the
+    relayout, keeping whatever moved."""
     from jax.experimental.layout import Format, Layout
 
     blocks = params.get("frozen_base", {}).get("blocks")
@@ -273,6 +280,9 @@ def relayout_for_decode(params: Params) -> Params:
         if name in attn and getattr(attn[name], "ndim", 0) == 3
     }
     if not targets:
+        return params
+    total = sum(x.size * x.dtype.itemsize for x in targets.values())
+    if total < min_bytes:
         return params
     # one leaf at a time WITH source donation: near the HBM limit the
     # whole-tree form holds old + new copies of all three stacks at once

@@ -104,10 +104,13 @@ class JaxILQLTrainer(BaseRLTrainer):
             self.params, self.opt
         )
         # decode-preferred at-rest layout for the frozen attention stacks
-        # (see trlx_tpu.parallel.relayout_for_decode)
+        # — size-gated no-op below 6B-class stacks (see the PPO trainer's
+        # note and trlx_tpu.parallel.relayout_for_decode)
         from trlx_tpu.parallel import relayout_for_decode
 
-        self.params = relayout_for_decode(self.params)
+        relayouted = relayout_for_decode(self.params)
+        self._layout_faithful = relayouted is not self.params
+        self.params = relayouted
 
         # [V] or [V, V] boolean; True = DISALLOWED (the reference passes the
         # adjacency complement, examples/ilql_randomwalks.py:72)
@@ -221,23 +224,30 @@ class JaxILQLTrainer(BaseRLTrainer):
             batch = jax.tree_util.tree_map(lambda x: x[idx], dataset)
             return train_step(params, opt_state, batch)
 
-        # aot_jit + pinned params-output formats: custom at-rest layouts
-        # survive only the AOT compile path, and the donated update must
-        # re-emit them or the next decode recompiles for default layouts
-        # (see the PPO trainer's identical note)
-        params_fmt = formats_of(self.params)
-        opt_fmt = formats_of(self.opt_state)
-        self._train_step = aot_jit(
-            train_step, donate_argnums=(0, 1),
-            out_shardings=(params_fmt, opt_fmt, None),
-        )
-        self._train_step_indexed = aot_jit(
-            train_step_indexed, donate_argnums=(0, 1),
-            out_shardings=(params_fmt, opt_fmt, None),
-        )
-        self._sync = aot_jit(
-            lambda p: sync_targets(p, m.alpha), out_shardings=params_fmt
-        )
+        # plain jit (fast C++ dispatch) unless the 6B-class relayout
+        # engaged — then the AOT path + pinned output formats keep the
+        # custom at-rest layouts alive across donated updates (see the
+        # PPO trainer's identical note)
+        if self._layout_faithful:
+            params_fmt = formats_of(self.params)
+            opt_fmt = formats_of(self.opt_state)
+            self._train_step = aot_jit(
+                train_step, donate_argnums=(0, 1),
+                out_shardings=(params_fmt, opt_fmt, None),
+            )
+            self._train_step_indexed = aot_jit(
+                train_step_indexed, donate_argnums=(0, 1),
+                out_shardings=(params_fmt, opt_fmt, None),
+            )
+            self._sync = aot_jit(
+                lambda p: sync_targets(p, m.alpha), out_shardings=params_fmt
+            )
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+            self._train_step_indexed = jax.jit(
+                train_step_indexed, donate_argnums=(0, 1)
+            )
+            self._sync = jax.jit(lambda p: sync_targets(p, m.alpha))
         self._generate_fn = generate_fn
         self._generate_jitted = {}
 
@@ -260,7 +270,8 @@ class JaxILQLTrainer(BaseRLTrainer):
                 eos_token_id=eos,
                 pad_token_id=eos,
             )
-            self._generate_jitted[key] = aot_jit(
+            jit_ = aot_jit if self._layout_faithful else jax.jit
+            self._generate_jitted[key] = jit_(
                 lambda p, q, m, r: self._generate_fn(p, q, m, r, gen_config)
             )
         (query, mask), n = self._pad_rows(
@@ -322,6 +333,12 @@ class JaxILQLTrainer(BaseRLTrainer):
 
     def set_components(self, components: Dict) -> None:
         self.params = components["params"]
+        if getattr(self, "_layout_faithful", False):
+            # re-pin the custom at-rest layouts after a restore (see the
+            # PPO trainer's identical note)
+            from trlx_tpu.parallel import relayout_for_decode
+
+            self.params = relayout_for_decode(self.params)
         self.opt_state = components["opt_state"]
         self.iter_count = int(components["state"]["iter_count"])
         self._rng = jax.random.wrap_key_data(

@@ -166,10 +166,16 @@ class JaxPPOTrainer(BaseRLTrainer):
         )
         # decode-preferred at-rest layout for the frozen attention stacks:
         # removes the rollout program's full-stack layout-copy temps
-        # (~2.5 GB at gpt-j-6B — see relayout_for_decode)
+        # (~2.5 GB at gpt-j-6B). Size-gated inside: below ~2 GiB of
+        # stacks it returns the SAME object and the trainer keeps plain
+        # jit's fast C++ dispatch (see relayout_for_decode — the AOT path
+        # custom layouts require costs ~seconds per dispatch on tunneled
+        # runtimes, a trade only 6B-class models win).
         from trlx_tpu.parallel import relayout_for_decode
 
-        self.params = relayout_for_decode(self.params)
+        relayouted = relayout_for_decode(self.params)
+        self._layout_faithful = relayouted is not self.params
+        self.params = relayouted
 
         # --- rollout machinery --------------------------------------------
         self.store = PPORolloutStorage()
@@ -377,32 +383,41 @@ class JaxPPOTrainer(BaseRLTrainer):
             batch = jax.tree_util.tree_map(lambda x: x[idx], store_batch)
             return train_multi(params, opt_state, batch)
 
-        # aot_jit (not jax.jit): the params carry custom at-rest layouts
-        # (relayout_for_decode) that only the AOT compile path preserves —
-        # plain jit would re-layout them every dispatch and re-materialize
-        # the decode layout-copy temps (trlx_tpu.utils.aotjit). The train
-        # steps additionally pin their params OUTPUT to the input formats:
-        # without that, the donated update emits default-layout frozen
-        # leaves, and the NEXT cycle's rollout recompiles for default
-        # layouts — resurrecting the copies (observed: a 6B second-cycle
-        # OOM after a clean first cycle).
-        params_fmt = formats_of(self.params)
-        opt_fmt = formats_of(self.opt_state)
-        self._generate_fn = aot_jit(generate_fn)
-        self._rollout_fn = aot_jit(rollout_fn)
+        # Default: plain jax.jit (C++ fastpath dispatch). When the
+        # relayout engaged (6B-class frozen stacks), the params carry
+        # custom at-rest layouts that only the AOT compile path preserves
+        # — plain jit would re-layout them every dispatch and
+        # re-materialize the decode layout-copy temps
+        # (trlx_tpu.utils.aotjit). The train steps then additionally pin
+        # their params+opt-state OUTPUTS to the input formats: without
+        # that, the donated update emits default-layout frozen leaves and
+        # the NEXT cycle's rollout recompiles for default layouts —
+        # resurrecting the copies (observed: a 6B second-cycle OOM after
+        # a clean first cycle).
+        if self._layout_faithful:
+            train_out = (formats_of(self.params),
+                         formats_of(self.opt_state), None)
+            self._generate_fn = aot_jit(generate_fn)
+            self._rollout_fn = aot_jit(rollout_fn)
+            self._train_step = aot_jit(
+                train_step, donate_argnums=(0, 1), out_shardings=train_out
+            )
+            self._train_multi = aot_jit(
+                train_multi, donate_argnums=(0, 1), out_shardings=train_out
+            )
+            self._train_multi_indexed = aot_jit(
+                train_multi_indexed, donate_argnums=(0, 1),
+                out_shardings=train_out,
+            )
+        else:
+            self._generate_fn = jax.jit(generate_fn)
+            self._rollout_fn = jax.jit(rollout_fn)
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+            self._train_multi = jax.jit(train_multi, donate_argnums=(0, 1))
+            self._train_multi_indexed = jax.jit(
+                train_multi_indexed, donate_argnums=(0, 1)
+            )
         self._finalize_rewards = jax.jit(finalize_rewards)
-        self._train_step = aot_jit(
-            train_step, donate_argnums=(0, 1),
-            out_shardings=(params_fmt, opt_fmt, None),
-        )
-        self._train_multi = aot_jit(
-            train_multi, donate_argnums=(0, 1),
-            out_shardings=(params_fmt, opt_fmt, None),
-        )
-        self._train_multi_indexed = aot_jit(
-            train_multi_indexed, donate_argnums=(0, 1),
-            out_shardings=(params_fmt, opt_fmt, None),
-        )
 
     # -- BaseRLTrainer surface ------------------------------------------ #
 
@@ -475,6 +490,15 @@ class JaxPPOTrainer(BaseRLTrainer):
 
     def set_components(self, components: Dict) -> None:
         self.params = components["params"]
+        if getattr(self, "_layout_faithful", False):
+            # checkpoint restore rebuilds default layouts, but the jitted
+            # closures pinned the custom at-rest formats — without
+            # re-applying, the next rollout AOT-compiles for default
+            # layouts and re-materializes the layout-copy temps (the 6B
+            # single-chip OOM the relayout exists to prevent)
+            from trlx_tpu.parallel import relayout_for_decode
+
+            self.params = relayout_for_decode(self.params)
         self.opt_state = components["opt_state"]
         state = components["state"]
         self.iter_count = int(state["iter_count"])
