@@ -115,6 +115,16 @@ def install_shims():
 
         transformers.top_k_top_p_filtering = top_k_top_p_filtering
 
+    if "deepspeed" not in sys.modules:
+        # the reference's ILQL network imports deepspeed at module level
+        # (ilql_models.py:8) but only touches it under
+        # DEEPSPEED_ZERO_STAGE=3; an empty stub satisfies the import
+        ds = types.ModuleType("deepspeed")
+        ds.__spec__ = importlib.machinery.ModuleSpec(
+            "deepspeed", loader=None
+        )
+        sys.modules["deepspeed"] = ds
+
     import accelerate.tracking
 
     accelerate.tracking.get_available_trackers = lambda: []
@@ -366,3 +376,250 @@ def run_trlx_tpu_ppo(ckpt, h=HPARAMS):
     trainer.learn(log_fn=lambda s: None)
     assert trainer.iter_count >= h["total_steps"]
     return trajectory
+
+
+# --------------------------------------------------------------------- #
+# ILQL head-to-head (randomwalks — the reference's own offline task)
+# --------------------------------------------------------------------- #
+
+ILQL_HPARAMS = dict(
+    epochs=20, batch_size=80, gen_size=10, learning_rate=1e-3,
+    lr_ramp_steps=100, lr_decay_steps=3366, eval_interval=50,
+    tau=0.7, gamma=0.99, cql_scale=0.1, awac_scale=1.0, alpha=1.0,
+    steps_for_target_q_sync=10, beta=4.0, two_qs=True,
+)
+
+
+def reference_randomwalks(seed=1000):
+    """The reference example's own data generator (walks, logit_mask,
+    stats_fn), loaded from /root/reference/examples — runtime data shared
+    by both frameworks so the comparison is apples-to-apples."""
+    import importlib.util
+
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    install_shims()
+    spec = importlib.util.spec_from_file_location(
+        "_ref_randomwalks",
+        os.path.join(REFERENCE_ROOT, "examples", "ilql_randomwalks.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.generate_random_walks(seed=seed)
+
+
+def walk_reward_fn(samples):
+    """The randomwalks return: -steps-to-goal, -100 when the goal (node 0)
+    is never reached (semantics of the reference example's inline
+    reward_fn; accepts torch tensors or numpy rows)."""
+    rewards = []
+    for s in samples:
+        s = [int(x) for x in s]
+        if s[-1] == 0:
+            for ix, tok in enumerate(s):
+                if tok == 0:
+                    rewards.append(-ix - 1)
+                    break
+        else:
+            rewards.append(-100)
+    return rewards
+
+
+def run_reference_ilql(h=ILQL_HPARAMS, seed=1000):
+    """Drive the reference ILQL stack (CausalLMWithValueHeads +
+    OfflineOrchestrator + ILQLModel.learn) on the randomwalks task.
+
+    Returns (percentage_trajectory, init_state) where init_state carries
+    numpy copies of EVERY weight (trunk + q/v/target heads) captured
+    BEFORE training — run_trlx_tpu_ilql starts from exactly these."""
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    install_shims()
+
+    import torch
+    from transformers import GPT2Config
+
+    from trlx.data.configs import TRLConfig
+    from trlx.model.accelerate_ilql_model import ILQLModel
+    from trlx.orchestrator.offline_orchestrator import OfflineOrchestrator
+
+    config = TRLConfig.load_yaml(
+        os.path.join(REFERENCE_ROOT, "configs", "ilql_config.yml")
+    )
+    config.train.gen_size = h["gen_size"]
+    config.train.epochs = h["epochs"]
+    config.train.batch_size = h["batch_size"]
+    config.train.eval_interval = h["eval_interval"]
+    config.train.learning_rate_init = h["learning_rate"]
+    config.train.learning_rate_target = h["learning_rate"]
+    config.train.lr_ramp_steps = h["lr_ramp_steps"]
+    config.train.lr_decay_steps = h["lr_decay_steps"]
+
+    walks, logit_mask, stats_fn = reference_randomwalks(seed=seed)
+    eval_prompts = torch.arange(1, logit_mask.shape[0]).view(-1, 1)
+    config.model.model_path = GPT2Config(
+        n_layer=4, n_embd=144, vocab_size=logit_mask.shape[0]
+    )
+
+    torch.manual_seed(7)
+    model = ILQLModel(config=config, logit_mask=logit_mask)
+
+    import numpy as np
+
+    init_state = {
+        "gpt": {k: v.detach().numpy().copy()
+                for k, v in model.model.gpt.state_dict().items()},
+        "heads": {
+            name: [p.detach().numpy().copy()
+                   for p in getattr(model.model, name).parameters()]
+            for name in ("v_head", "q1_head", "q2_head",
+                          "target_q1_head", "target_q2_head")
+        },
+        "config": model.model.gpt.config,
+    }
+
+    trajectory = []
+    base_stats_fn = stats_fn
+
+    def recording_stats(samples):
+        out = base_stats_fn(samples)
+        trajectory.append(float(out["percentage"]))
+        return out
+
+    OfflineOrchestrator(
+        model=model, train_samples=walks, eval_prompts=eval_prompts,
+        reward_fn=walk_reward_fn, stats_fn=recording_stats,
+    )
+    model.learn()
+    return trajectory, init_state
+
+
+def run_trlx_tpu_ilql(init_state, h=ILQL_HPARAMS, seed=1000):
+    """trlx_tpu ILQL from the reference's exact initial weights (trunk,
+    q/v heads, target heads) on the same walks; returns the percentage
+    trajectory (one entry per eval point)."""
+    import numpy as np
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.models import hf_import
+    from trlx_tpu.utils.loading import get_model, get_orchestrator
+
+    walks, logit_mask, stats_fn = reference_randomwalks(seed=seed)
+    V = int(logit_mask.shape[0])
+    config = TRLConfig.from_dict({
+        "model": {
+            "model_path": "from-config", "tokenizer_path": "byte",
+            "model_type": "ILQLModel", "num_layers_unfrozen": -1,
+            # n_head=12: GPT2Config's DEFAULT — the reference example only
+            # overrides n_layer/n_embd/vocab_size, so the imported trunk's
+            # attention is grouped 12-wide; a different n_head here would
+            # silently scramble the imported weights' function. The head
+            # stays TIED: at num_layers_unfrozen=-1 both frameworks train
+            # the embeddings (round-5 parity), so the tied logits learn
+            # through wte exactly as the reference's do.
+            "model_spec": {
+                "vocab_size": V, "n_layer": 4, "n_head": 12,
+                "d_model": 144, "n_positions": 16,
+            },
+            "compute_dtype": "float32",
+        },
+        "train": {
+            "n_ctx": 16, "epochs": h["epochs"], "total_steps": 10**9,
+            "batch_size": h["batch_size"], "grad_clip": 1e9,
+            # the reference's rampup_decay chains LinearLR(start_factor=
+            # target/init, ...): with init == target its "ramp" is a
+            # CONSTANT lr from step 0 (reference utils/__init__.py:29-36).
+            # Our schedule warms from 0, so ramp=1 here reproduces the
+            # reference's effective constant-lr schedule.
+            "lr_ramp_steps": 1,
+            "lr_decay_steps": h["lr_decay_steps"],
+            "weight_decay": 0.01,  # torch AdamW default (reference passes none)
+            "learning_rate_init": h["learning_rate"],
+            "learning_rate_target": h["learning_rate"],
+            "log_interval": 10**9, "checkpoint_interval": 10**9,
+            "eval_interval": h["eval_interval"],
+            "pipeline": "OfflinePipeline",
+            "orchestrator": "OfflineOrchestrator",
+            "input_size": 1, "gen_size": h["gen_size"], "seed": 3,
+        },
+        "method": {
+            "name": "ilqlconfig", "tau": h["tau"], "gamma": h["gamma"],
+            "cql_scale": h["cql_scale"], "awac_scale": h["awac_scale"],
+            "alpha": h["alpha"],
+            "steps_for_target_q_sync": h["steps_for_target_q_sync"],
+            "beta": h["beta"], "two_qs": h["two_qs"],
+        },
+    })
+
+    mask = np.asarray(init_state_mask(logit_mask))
+    trainer = get_model(config.model.model_type)(config, logit_mask=mask)
+
+    # import the reference's exact init: trunk via the HF converter,
+    # heads by transposing the torch Sequential(make_head) weights
+    import torch
+
+    sd = {k: torch.tensor(v) for k, v in init_state["gpt"].items()}
+    spec = hf_import.spec_from_hf_config(init_state["config"])
+    embed, blocks, ln_f = hf_import.convert_state_dict(sd, spec)
+    params = hf_import.ilql_params_from_trunk(
+        trainer.net, embed, blocks, ln_f,
+        __import__("jax").random.PRNGKey(5),
+    )
+
+    def head_tree(torch_params):
+        w1, b1, w2, b2 = torch_params
+        return {
+            "w1": np.asarray(w1).T.copy(), "b1": np.asarray(b1).copy(),
+            "w2": np.asarray(w2).T.copy(), "b2": np.asarray(b2).copy(),
+        }
+
+    import jax.numpy as jnp
+
+    as_jnp = lambda t: {k: jnp.asarray(v) for k, v in t.items()}
+    params["trainable"]["v_head"] = as_jnp(
+        head_tree(init_state["heads"]["v_head"])
+    )
+    params["trainable"]["q1_head"] = as_jnp(
+        head_tree(init_state["heads"]["q1_head"])
+    )
+    params["trainable"]["q2_head"] = as_jnp(
+        head_tree(init_state["heads"]["q2_head"])
+    )
+    params["target"]["q1_head"] = as_jnp(
+        head_tree(init_state["heads"]["target_q1_head"])
+    )
+    params["target"]["q2_head"] = as_jnp(
+        head_tree(init_state["heads"]["target_q2_head"])
+    )
+    trainer.params = params
+    trainer.opt_state = trainer.opt.init(trainer.params["trainable"])
+
+    eval_prompts = np.arange(1, V).reshape(-1, 1)
+    trajectory = []
+
+    def recording_stats(samples):
+        out = stats_fn_to_py(stats_fn, samples)
+        trajectory.append(float(out["percentage"]))
+        return out
+
+    get_orchestrator(config.train.orchestrator)(
+        trainer, [np.asarray(w) for w in walks], eval_prompts,
+        reward_fn=walk_reward_fn, stats_fn=recording_stats,
+    )
+    trainer.learn(log_fn=lambda s: None)
+    return trajectory
+
+
+def init_state_mask(logit_mask):
+    """torch bool [V, V] -> numpy (True = disallowed), the convention both
+    frameworks share (the reference passes the adjacency complement)."""
+    import numpy as np
+
+    return np.asarray(logit_mask.numpy() if hasattr(logit_mask, "numpy")
+                      else logit_mask, bool)
+
+
+def stats_fn_to_py(stats_fn, samples):
+    """The reference stats_fn indexes sample rows like tensors; numpy rows
+    satisfy it directly."""
+    return stats_fn(samples)

@@ -400,8 +400,9 @@ def test_ilql_full_step_matches_reference_replica():
     hf.eval()
     trainer = build_ilql_trainer_from_hf(hf)
 
-    # torch replica: trunk all-trainable except embeddings; MLP heads
-    # cloned from our random-initialized ones; target heads frozen
+    # torch replica: FULLY trainable trunk including embeddings (round-5
+    # full-unfreeze semantics); MLP heads cloned from our
+    # random-initialized ones; target heads frozen
     for p in hf.parameters():
         p.requires_grad_(False)
     for blk in hf.transformer.h:
@@ -421,9 +422,15 @@ def test_ilql_full_step_matches_reference_replica():
     for name in ("tq1", "tq2"):
         for p in heads[name].parameters():
             p.requires_grad_(False)
+    # full unfreeze (num_layers_unfrozen=-1) trains the embeddings too
+    # since round 5 — reference parity: its freeze list is empty and the
+    # tied lm logits learn through wte (ilql_models.py:57-65)
+    hf.transformer.wte.weight.requires_grad_(True)
+    hf.transformer.wpe.weight.requires_grad_(True)
     trainable_torch = (
         [p for blk in hf.transformer.h for p in blk.parameters()]
         + list(hf.transformer.ln_f.parameters())
+        + [hf.transformer.wte.weight, hf.transformer.wpe.weight]
         + list(heads["q1"].parameters())
         + list(heads["q2"].parameters())
         + list(heads["v"].parameters())
@@ -471,10 +478,13 @@ def test_ilql_full_step_matches_reference_replica():
         float(stats["loss"]), torch_results[-1][0], rtol=2e-3
     )
 
-    # torch post-step params mapped into our layout
+    # torch post-step params mapped into our layout (embeddings included:
+    # full unfreeze trains them since round 5)
     spec = spec_from_hf_config(cfg)
-    _, blocks2, ln_f2 = convert_state_dict(hf.state_dict(), spec)
+    embed2, blocks2, ln_f2 = convert_state_dict(hf.state_dict(), spec)
+    embed2.pop("lm_head", None)
     torch_after = {
+        "embed": jax.tree_util.tree_map(np.asarray, embed2),
         "blocks": jax.tree_util.tree_map(np.asarray, blocks2),
         "ln_f": jax.tree_util.tree_map(np.asarray, ln_f2),
         "q1_head": {

@@ -373,8 +373,13 @@ def ilql_params_from_trunk(
         target["q2_head"] = jax.tree_util.tree_map(jnp.copy, q2)
     if lm_head is not None:
         trainable["lm_head"] = lm_head
+    from trlx_tpu.models.ilql import split_embed_for_unfreeze
+
+    frozen_embed, train_embed = split_embed_for_unfreeze(embed, k, spec)
+    if train_embed is not None:
+        trainable["embed"] = train_embed
     return {
-        "frozen_base": {"embed": embed, "blocks": bottom},
+        "frozen_base": {"embed": frozen_embed, "blocks": bottom},
         "trainable": trainable,
         "target": target,
     }

@@ -38,6 +38,27 @@ from trlx_tpu.models.transformer import (
 Params = Dict[str, Any]
 
 
+def split_embed_for_unfreeze(embed: Params, k: int, spec) -> Tuple[Params, Any]:
+    """(frozen_embed, trainable_embed | None): at FULL unfreeze
+    (k == n_layer) the embeddings move into the trainable branch —
+    reference parity: num_layers_unfrozen=-1 trains EVERYTHING including
+    wte/wpe (its freeze list is empty, reference ilql_models.py:57-65),
+    and with a tied head the lm logits then learn through wte. ILQL has
+    no frozen reference branch, so this is semantically safe (the PPO
+    hydra keeps embeddings frozen: its ref-branch logprobs read the same
+    embed, and training it would silently move the KL reference).
+
+    One definition shared by ILQLModel._init and
+    hf_import.ilql_params_from_trunk so from-config and HF-imported
+    runs can never diverge on what gets gradients. NOTE: this changed
+    the params/opt-state tree at num_layers_unfrozen=-1 in round 5 —
+    checkpoints saved by earlier rounds at full unfreeze have the old
+    structure and are not restorable without re-nesting embed."""
+    if k == spec.n_layer:
+        return {}, embed
+    return embed, None
+
+
 @dataclass(frozen=True)
 class ILQLModel:
     """Static description; methods are pure functions over the params tree."""
@@ -94,11 +115,21 @@ class ILQLModel:
             target["q2_head"] = jax.tree_util.tree_map(jnp.copy, q2)
         if lm_head is not None:
             trainable["lm_head"] = lm_head
+        frozen_embed, train_embed = split_embed_for_unfreeze(embed, k, spec)
+        if train_embed is not None:
+            trainable["embed"] = train_embed
         return {
-            "frozen_base": {"embed": embed, "blocks": bottom},
+            "frozen_base": {"embed": frozen_embed, "blocks": bottom},
             "trainable": trainable,
             "target": target,
         }
+
+    def embed_params(self, params: Params) -> Params:
+        """The token/position embedding table — trainable at full
+        unfreeze, frozen otherwise (see _init)."""
+        return params["trainable"].get(
+            "embed", params["frozen_base"]["embed"]
+        )
 
     # -- forward ------------------------------------------------------------
 
@@ -144,7 +175,7 @@ class ILQLModel:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         mask_bias = mask_arg_for(self._attn(), attention_mask)
         h = embed_tokens(
-            params["frozen_base"]["embed"], spec, tokens, positions,
+            self.embed_params(params), spec, tokens, positions,
             self.compute_dtype,
         )
         if self._pp_active():
@@ -173,7 +204,7 @@ class ILQLModel:
         post-ln_f hidden state — h [..., D] -> [..., V] for the first
         three, -> [...] (squeezed) for v_fn; target fns stop their
         gradient (parity: reference ilql_models.py:86-100)."""
-        head_params = dict(params["frozen_base"]["embed"])
+        head_params = dict(self.embed_params(params))
         if "lm_head" in params["trainable"]:
             head_params["lm_head"] = params["trainable"]["lm_head"]
         lm_fn = functools.partial(project_logits, head_params, self.spec)
@@ -216,7 +247,7 @@ class ILQLModel:
         )
 
     def head_params_for_decode(self, params: Params):
-        embed = dict(params["frozen_base"]["embed"])
+        embed = dict(self.embed_params(params))
         if "lm_head" in params["trainable"]:
             embed["lm_head"] = params["trainable"]["lm_head"]
         return embed, params["trainable"]["ln_f"]

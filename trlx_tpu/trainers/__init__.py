@@ -219,7 +219,8 @@ class BaseRLTrainer:
         return None
 
     def _check_memory_fit(self, spec, frozen_dtype, ref_branch=True,
-                          extra_trainable=0, extra_frozen=0) -> None:
+                          extra_trainable=0, extra_frozen=0,
+                          embed_trainable=False) -> None:
         """Fail BEFORE allocation with an actionable message when the model
         state clearly cannot fit the per-device HBM budget (a 24 GB fp32
         gpt-j-6B OOMing mid-init is far harder to diagnose). Estimates
@@ -274,10 +275,18 @@ class BaseRLTrainer:
                 self.config.train, "adam_moment_dtype", "float32"
             )
             opt_bytes = (2 if mu_dtype == "bfloat16" else 4) + 4
+        # ILQL full unfreeze trains the embeddings (round-5 parity,
+        # trlx_tpu.models.ilql.split_embed_for_unfreeze): their fp32 +
+        # optimizer bytes move into the trainable term — at 6B scale the
+        # ~206M embed params carry ~1.6 GB of Adam moments that must not
+        # be omitted
+        embed_train = embed if embed_trainable else 0
+        embed_frozen = 0 if embed_trainable else embed
         est = (
-            ((L - k) * per_layer + embed) * frozen_sz   # frozen trunk
+            ((L - k) * per_layer + embed_frozen) * frozen_sz  # frozen trunk
             + (k * per_layer + lm_head) * frozen_sz * (1 if ref_branch else 0)
-            + (k * per_layer + lm_head + extra_trainable) * (4 + opt_bytes)
+            + (k * per_layer + lm_head + embed_train + extra_trainable)
+            * (4 + opt_bytes)
             + extra_frozen * frozen_sz
         )
         shards = 1

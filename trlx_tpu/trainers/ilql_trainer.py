@@ -70,6 +70,10 @@ class JaxILQLTrainer(BaseRLTrainer):
             spec, jnp.float32, ref_branch=False,
             extra_trainable=head_params,
             extra_frozen=n_q * spec.d_model * spec.vocab_size,
+            embed_trainable=(
+                resolve_num_unfrozen(spec, config.model.num_layers_unfrozen)
+                == spec.n_layer
+            ),
         )
         self.net = ILQLNet(
             spec=spec,
