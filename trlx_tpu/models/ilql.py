@@ -44,9 +44,14 @@ def split_embed_for_unfreeze(embed: Params, k: int, spec) -> Tuple[Params, Any]:
     reference parity: num_layers_unfrozen=-1 trains EVERYTHING including
     wte/wpe (its freeze list is empty, reference ilql_models.py:57-65),
     and with a tied head the lm logits then learn through wte. ILQL has
-    no frozen reference branch, so this is semantically safe (the PPO
-    hydra keeps embeddings frozen: its ref-branch logprobs read the same
-    embed, and training it would silently move the KL reference).
+    no frozen reference branch, so this is straightforwardly safe. (The
+    PPO hydra keeps embeddings frozen at every k — a DELIBERATE design
+    difference, not an oversight: the reference trains wte/wpe there too
+    and lets its frozen-top ref branch read the drifting trunk, whereas
+    our frozen-embed split keeps the KL reference fully static AND
+    enables frozen-dtype storage with zero optimizer state for the
+    trunk — the 6B-on-one-chip levers. The PPO head-to-head shows
+    matched-or-better learning despite the difference.)
 
     One definition shared by ILQLModel._init and
     hf_import.ilql_params_from_trunk so from-config and HF-imported
