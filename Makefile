@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test
+.PHONY: style check test faults
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -16,3 +16,11 @@ style:
 
 test:
 	python -m pytest tests/ -x -q
+
+# fault-injection tier: atomic-checkpoint crash scenarios, divergence
+# containment (NaN skip / rollback / second-strike abort), flaky host
+# seams, preemption corner cases. Part of the non-slow tier-1 set; this
+# target runs just them for a fast robustness signal.
+faults:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py \
+		tests/test_checkpoint.py -q

@@ -59,6 +59,9 @@ def offline_pieces(config):
     config.model.compute_dtype = "float32"
     config.train.epochs = 6
     config.train.total_steps = 200
+    # save often enough that a killed demo run has something to resume
+    # from (the YAML's resume_from: auto picks it up on the next launch)
+    config.train.checkpoint_interval = 50
     config.train.batch_size = 64
     config.method.num_rollouts = 64
     config.method.chunk_size = 64
@@ -95,6 +98,13 @@ def main():
         reward_fn, prompts = offline_pieces(config)
 
     trainer = get_model(config.model.model_type)(config)
+    # the shipped config says resume_from: "auto" — kill this script at
+    # any point and relaunch it; it continues from the newest committed
+    # checkpoint under train.checkpoint_dir (keep_checkpoints bounds the
+    # disk it uses). First launch: nothing to resume, fresh start.
+    if getattr(trainer, "_resumed", False):
+        print(f"resumed from checkpoint at iter {trainer.iter_count} "
+              f"(train.resume_from: {config.train.resume_from!r})")
     pipeline = get_pipeline(config.train.pipeline)(
         prompts, trainer.tokenizer, config
     )

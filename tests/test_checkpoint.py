@@ -261,6 +261,44 @@ def test_sigterm_preemption_saves_and_resumes(tmp_path):
     assert resumed.iter_count == 8
 
 
+def test_resume_from_auto_with_retention_end_to_end(tmp_path):
+    """The fire-and-forget preemptible-job config: resume_from "auto" +
+    keep_checkpoints. Run 1 saves step checkpoints (only the newest N
+    kept); run 2 with the SAME config line resumes from the newest at
+    construction; a run pointed at an empty dir starts fresh."""
+    import os
+
+    config, trainer, orch = _built_trainer(tmp_path)
+    config.train.checkpoint_interval = 2
+    config.train.total_steps = 6
+    config.train.epochs = 100
+    config.train.keep_checkpoints = 2
+    orch.make_experience(config.method.num_rollouts)
+    trainer.learn(log_fn=lambda s: None)
+    assert trainer.iter_count == 6
+
+    # retention: steps 2, 4, 6 were saved; only the newest 2 remain
+    steps = sorted(e for e in os.listdir(config.train.checkpoint_dir)
+                   if e.startswith("step_"))
+    assert steps == ["step_4", "step_6"]
+
+    config2, resumed, orch2 = _built_trainer(tmp_path, seed=5)
+    config2.train.resume_from = "auto"
+    # construction already consumed resume_from="" — exercise the auto
+    # resolution explicitly, as a fresh construction would
+    assert resumed.maybe_resume()
+    assert resumed.iter_count == 6
+    for a, b in zip(_leaves(trainer.params["trainable"]),
+                    _leaves(resumed.params["trainable"])):
+        np.testing.assert_array_equal(a, b)
+
+    # empty checkpoint_dir + auto = fresh start, not an error
+    config3, fresh, _ = _built_trainer(tmp_path / "elsewhere", seed=3)
+    config3.train.resume_from = "auto"
+    assert not fresh.maybe_resume()
+    assert fresh.iter_count == 0
+
+
 def test_preemption_guard_disabled_by_config(tmp_path):
     """train.save_on_preemption=false keeps the default SIGTERM behavior:
     the guard never installs a handler during learn()."""
@@ -305,6 +343,71 @@ def test_preemption_poll_interval_skips_collectives(monkeypatch):
     results = [guard.poll() for _ in range(5)]
     assert results == [True, False, False, False, True]
     assert calls["allgather"] == 2
+
+
+def test_preemption_guard_off_main_thread_stays_inert():
+    """Python only allows signal handlers on the main thread; a guard
+    constructed/entered anywhere else must stay inert (no handler change,
+    no exception) rather than crashing a worker-thread learn() call."""
+    import signal
+    import threading
+
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    prev = signal.getsignal(signal.SIGTERM)
+    results = {}
+
+    def run():
+        with PreemptionGuard() as guard:
+            results["installed"] = guard._installed
+            results["poll"] = guard.poll()
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert results == {"installed": False, "poll": False}
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_poll_interval_boundaries(monkeypatch):
+    """Rank-agreement arithmetic at the interval edges: calls 1, N+1,
+    2N+1 are the collective boundaries ((polls - 1) % N == 0) — call N
+    itself is NOT one, and poll_interval=1 makes every call collective.
+    All ranks count calls identically, so they agree on which boundaries
+    run the allgather."""
+    import numpy as np
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    calls = {"allgather": 0}
+
+    def fake_allgather(x):
+        calls["allgather"] += 1
+        return np.stack([np.asarray(x), np.asarray([0.0], np.float32)])
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+
+    guard = PreemptionGuard(poll_interval=3)
+    boundaries = []
+    for i in range(1, 8):
+        before = calls["allgather"]
+        guard.poll()
+        if calls["allgather"] > before:
+            boundaries.append(i)
+    assert boundaries == [1, 4, 7]
+
+    calls["allgather"] = 0
+    every = PreemptionGuard(poll_interval=1)
+    for _ in range(5):
+        every.poll()
+    assert calls["allgather"] == 5
+
+    # sub-1 intervals clamp to 1 rather than dividing by zero
+    assert PreemptionGuard(poll_interval=0)._poll_interval == 1
 
 
 def test_preemption_guard_restores_sig_dfl_for_c_handlers(monkeypatch):

@@ -91,9 +91,12 @@ def test_ilql_sentiments_online_glue(monkeypatch):
     assert scores[1] == pytest.approx(0.0)
 
 
-def test_ppo_sentiments_online_pieces_drive_end_to_end(monkeypatch):
+def test_ppo_sentiments_online_pieces_drive_end_to_end(monkeypatch,
+                                                       tmp_path):
     """The mocked online reward_fn must run a REAL rollout+learn pass
-    (tiny model) — the full online wiring minus the network."""
+    (tiny model) — the full online wiring minus the network. The shipped
+    YAML's durable-run knobs ride along: resume_from "auto" must resolve
+    to a fresh start here (hermetic checkpoint_dir, no prior run)."""
     mod = load_example("ppo_sentiments")
     texts = ["good words here", "MORE WORDS", "fine film indeed"] * 40
     install_fake_hf(monkeypatch, texts)
@@ -117,7 +120,11 @@ def test_ppo_sentiments_online_pieces_drive_end_to_end(monkeypatch):
     config.method.num_rollouts = 16
     config.method.chunk_size = 16
     config.method.gen_kwargs.update(max_length=8, min_length=8)
+    # keep the YAML's resume_from "auto" but point it at a clean dir so
+    # the test is hermetic whatever ran before it
+    config.train.checkpoint_dir = str(tmp_path / "ckpt")
     trainer = get_model(config.model.model_type)(config)
+    assert not getattr(trainer, "_resumed", False)  # fresh start
     from trlx_tpu.utils.tokenizer import ByteTokenizer
 
     trainer.tokenizer = ByteTokenizer()

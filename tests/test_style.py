@@ -5,6 +5,13 @@ Covers the highest-signal subset of the configured ruff rules
 (pyproject.toml [tool.ruff]): files must parse, no unused module-level
 imports (F401, minus `# noqa` re-export shims), no tabs in indentation,
 no trailing whitespace, and no `== None` / `!= None` comparisons (E711).
+
+Library-only rule (trlx_tpu/): no bare ``except:`` and no
+exception-swallowing ``except ...: pass`` handlers. The reference's
+checkpoint save/load wrapped everything in try/except-pass — which is
+exactly how its checkpointing shipped dead and nobody noticed (SURVEY
+§3.6). A handler must re-raise, return, log, or otherwise DO something
+with the failure.
 """
 
 import ast
@@ -79,6 +86,23 @@ def test_lint(path):
             problems.append(f"line {i}: trailing whitespace (W291)")
         if stripped[: len(stripped) - len(stripped.lstrip())].count("\t"):
             problems.append(f"line {i}: tab in indentation (W191)")
+
+    if (REPO / "trlx_tpu") in path.parents:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                problems.append(
+                    f"line {node.lineno}: bare 'except:' (E722) — name "
+                    f"the exception; the reference's swallowed-exception "
+                    f"checkpointing is the bug class this forbids"
+                )
+            elif all(isinstance(stmt, ast.Pass) for stmt in node.body):
+                problems.append(
+                    f"line {node.lineno}: exception-swallowing "
+                    f"'except ...: pass' — re-raise, return a fallback, "
+                    f"or log the failure"
+                )
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Compare):

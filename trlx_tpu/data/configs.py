@@ -171,7 +171,17 @@ class TrainConfig:
     :param remat: rematerialize transformer blocks in the backward pass
     :param debug_nans: enable jax_debug_nans — jitted programs fail fast at
         the op that produced a NaN instead of training on garbage (SURVEY
-        §5 sanitizer gap; costs recompiles + sync, debug only)
+        §5 sanitizer gap; costs recompiles + sync, debug only). For long
+        unattended runs prefer ``max_bad_steps`` (skip/rollback/abort —
+        trlx_tpu.utils.faults) over fail-fast.
+    :param resume_from: checkpoint dir, run dir, or "auto" (newest valid
+        checkpoint under ``checkpoint_dir``; fresh start when none)
+    :param keep_checkpoints: retention — newest N step checkpoints kept
+    :param max_bad_steps: consecutive skipped (non-finite / KL-breaching)
+        steps before rollback-to-checkpoint; second strike aborts
+    :param max_step_kl: PPO per-step policy-KL bound counted as bad
+    :param host_retries / host_retry_backoff: bounded retry for host
+        seams (reward_fn, trackers)
     """
 
     n_ctx: int
@@ -211,9 +221,37 @@ class TrainConfig:
     seed: int = 0
     remat: bool = False
     checkpoint_dir: str = "ckpts"
-    # restore components from this checkpoint directory at the start of the
-    # first learn() call (kill-and-continue resume); "" disables
+    # restore components at trainer construction (kill-and-continue
+    # resume). A directory restores that checkpoint (or the newest valid
+    # "step_<N>" inside it); "auto" resumes from the newest valid
+    # checkpoint under checkpoint_dir and starts FRESH when there is none
+    # — the fire-and-forget setting for preemptible jobs (docs
+    # "Fault tolerance"). "" disables.
     resume_from: str = ""
+    # retention: keep only the newest N committed "step_<N>" checkpoints
+    # under checkpoint_dir, garbage-collecting older ones (and dead
+    # staging dirs from saves killed mid-write) after each successful
+    # save. 0 keeps everything.
+    keep_checkpoints: int = 0
+    # divergence containment (trlx_tpu.utils.faults.StepGuard): a train
+    # step with non-finite loss/grad-norm (or KL above max_step_kl) is
+    # SKIPPED on device — params/opt-state not committed — and counted;
+    # this many CONSECUTIVE bad steps roll the run back to its last
+    # checkpoint, and a second strike aborts with a diagnostic instead of
+    # training on garbage. 0 disables (no per-step verdict sync —
+    # reference-parity fast path).
+    max_bad_steps: int = 0
+    # PPO only: per-step bound on the policy-update KL (the train step's
+    # approx_kl stat, new policy vs rollout policy). A step above it
+    # counts as bad under max_bad_steps. 0 = finiteness checks only.
+    max_step_kl: float = 0.0
+    # bounded retry-with-backoff for host-side seams (user reward_fn
+    # calls, tracker emissions): extra attempts after the first failure,
+    # and the base backoff seconds (doubled per retry). A seam that still
+    # fails after the budget raises (reward) or degrades to stdout
+    # (tracker — trlx_tpu.utils.trackers.ResilientTracker).
+    host_retries: int = 2
+    host_retry_backoff: float = 0.5
     # PPO only: dispatch the next epoch's rollout programs BEFORE the
     # current epoch's updates drain (one host-sync saved per cycle — the
     # dominant per-cycle cost on tunneled/remote runtimes). Semantics:

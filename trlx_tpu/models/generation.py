@@ -121,7 +121,9 @@ def _use_unrolled_layers(
         if limit and static_bytes > 0.9 * limit:
             return False
     except Exception:
-        pass
+        # runtimes that expose no memory stats: the depth ceiling above
+        # already accepted this layer count, so unroll
+        return True
     return True
 
 

@@ -498,6 +498,16 @@ def make_pallas_attention_fn(
         from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
+    # the replication/varying-axes check kwarg was renamed check_rep ->
+    # check_vma across jax versions; resolve whichever this one has
+    import inspect
+
+    _check_kw = (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    )
+
     min_t = _MIN_FUSED_T if min_fused_t is None else min_fused_t
 
     def pallas_attention(q, k, v, attention_mask):
@@ -524,8 +534,8 @@ def make_pallas_attention_fn(
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
             out_specs=qkv_spec,
             # pallas_call's out_shape carries no varying-mesh-axes type;
-            # skip the vma check for this purely per-shard kernel
-            check_vma=False,
+            # skip the vma/rep check for this purely per-shard kernel
+            **{_check_kw: False},
         )(q, k, v, attention_mask)
 
     pallas_attention.takes_raw_mask = True

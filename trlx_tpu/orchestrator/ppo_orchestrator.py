@@ -95,10 +95,22 @@ class PPOOrchestrator(Orchestrator):
         process 0: host reward outputs (HF pipelines, service calls) are
         not guaranteed bit-identical across hosts, and they feed sharded
         device rewards — divergent floats would silently fork the SPMD
-        replicas."""
-        from trlx_tpu.parallel import broadcast_host_floats
+        replicas.
 
-        return broadcast_host_floats(self.reward_fn(texts))
+        The callback is the classic flaky host seam (a scoring service
+        timing out, an HF pipeline hiccup): it gets
+        train.host_retries retries with backoff before the run is
+        allowed to die (trlx_tpu.utils.faults.retry_call)."""
+        from trlx_tpu.parallel import broadcast_host_floats
+        from trlx_tpu.utils.faults import retry_call
+
+        t = self.rl_model.config.train
+        return broadcast_host_floats(retry_call(
+            self.reward_fn, texts,
+            retries=getattr(t, "host_retries", 2),
+            backoff=getattr(t, "host_retry_backoff", 0.5),
+            label="reward_fn",
+        ))
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Fill the trainer's rollout store with at least `num_rollouts`

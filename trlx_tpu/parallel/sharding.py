@@ -226,6 +226,32 @@ def shard_batch(mesh: Mesh, tree):
     return jax.device_put(tree, batch_sharding(mesh))
 
 
+def _layout_format_factory():
+    """``(major_to_minor, sharding) -> device_put target`` across the jax
+    layout-API rename, or None when neither spelling exists.
+
+    jax >= 0.5 spells it ``Format(Layout(major_to_minor=...), sharding)``;
+    0.4.x spells the same pair ``Layout(DeviceLocalLayout(major_to_minor=
+    ...), sharding)``. Older/stripped builds expose neither — the caller
+    must then skip the relayout instead of dying at import time (this is
+    a size-gated optimization, never a correctness requirement)."""
+    try:
+        from jax.experimental.layout import Format, Layout
+
+        return lambda m2m, sharding: Format(
+            Layout(major_to_minor=m2m), sharding
+        )
+    except ImportError:
+        try:
+            from jax.experimental.layout import DeviceLocalLayout, Layout
+
+            return lambda m2m, sharding: Layout(
+                DeviceLocalLayout(major_to_minor=m2m), sharding
+            )
+        except ImportError:
+            return None
+
+
 def relayout_for_decode(params: Params,
                         min_bytes: int = 2 << 30) -> Params:
     """Frozen-trunk attention projections (wq/wk/wv) moved to the
@@ -256,7 +282,11 @@ def relayout_for_decode(params: Params,
     input tree must be re-bound from the return value); degrades
     gracefully — with a warning — when the runtime rejects the
     relayout, keeping whatever moved."""
-    from jax.experimental.layout import Format, Layout
+    make_format = _layout_format_factory()
+    if make_format is None:
+        # jax versions without a usable custom-layout API: the pass is a
+        # no-op (same-object return keeps callers on the fast jit path)
+        return params
 
     blocks = params.get("frozen_base", {}).get("blocks")
     if not blocks or "attn" not in blocks:
@@ -293,7 +323,7 @@ def relayout_for_decode(params: Params,
     for name, x in targets.items():
         try:
             moved[name] = jax.device_put(
-                x, Format(Layout(major_to_minor=(0, 2, 1)), x.sharding),
+                x, make_format((0, 2, 1), x.sharding),
                 donate=True,
             )
         except Exception as e:  # noqa: BLE001 - capability probe by doing

@@ -382,15 +382,28 @@ class BaseRLTrainer:
 
     def save(self, directory: str = None) -> None:
         """Checkpoint components (reference's torch.save per component →
-        Orbax here; see trlx_tpu.utils.checkpoint). Single-writer: only
-        process 0 writes (params are replicated or re-shardable on
-        restore)."""
-        from trlx_tpu.parallel import is_main_process
-        from trlx_tpu.utils.checkpoint import save_components
+        Orbax here; see trlx_tpu.utils.checkpoint). Saves are
+        crash-atomic (staged + renamed — a preemption mid-save cannot
+        corrupt the previous checkpoint) and single-writer (process-0
+        gate lives inside save_components). With no explicit
+        `directory`, saves land as ``checkpoint_dir/step_<iter>`` with a
+        LATEST marker and ``train.keep_checkpoints`` retention — the
+        layout ``resume_from: auto`` and divergence rollback restore
+        from."""
+        from trlx_tpu.utils.checkpoint import (
+            save_components,
+            save_step_checkpoint,
+        )
 
-        if not is_main_process():
+        if directory is not None:
+            save_components(self.get_components(), directory)
             return
-        save_components(self.get_components(), directory or self.config.train.checkpoint_dir)
+        save_step_checkpoint(
+            self.get_components(),
+            self.config.train.checkpoint_dir,
+            step=getattr(self, "iter_count", 0),
+            keep=getattr(self.config.train, "keep_checkpoints", 0),
+        )
 
     def load(self, directory: str = None) -> None:
         from trlx_tpu.utils.checkpoint import restore_components
@@ -399,6 +412,52 @@ class BaseRLTrainer:
             self.get_components(), directory or self.config.train.checkpoint_dir
         )
         self.set_components(restored)
+
+    def _rollback_to_latest(self):
+        """Restore the newest valid checkpoint under checkpoint_dir (the
+        StepGuard's rollback hook). Returns the restored path, or None
+        when no committed checkpoint exists."""
+        from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+        directory = find_latest_checkpoint(self.config.train.checkpoint_dir)
+        if directory is None:
+            return None
+        self.load(directory)
+        return directory
+
+    def _make_step_guard(self, log_fn):
+        """The learn loops' divergence guard (trlx_tpu.utils.faults),
+        built from train.max_bad_steps; disabled (and cost-free) at the
+        default 0."""
+        from trlx_tpu.utils.faults import StepGuard
+
+        return StepGuard(
+            max_bad_steps=getattr(self.config.train, "max_bad_steps", 0),
+            rollback_fn=self._rollback_to_latest,
+            log=log_fn,
+        )
+
+    def _observe_step(self, step_guard, stats) -> None:
+        """Feed one jitted-step verdict to the StepGuard. Only syncs the
+        tiny bad_step flag to host when guarding is enabled — the
+        disabled path costs nothing per step."""
+        if step_guard is None or not step_guard.enabled:
+            return
+        import jax
+
+        host = jax.device_get(
+            {
+                k: stats[k]
+                for k in ("bad_step", "loss", "grad_norm", "approx_kl")
+                if k in stats
+            }
+        )
+        detail = {k: float(v) for k, v in host.items() if k != "bad_step"}
+        step_guard.observe(
+            bad=float(host.get("bad_step", 0.0)) > 0,
+            step=self.iter_count,
+            detail=detail,
+        )
 
     def _preempt(self, log_fn, guard, just_saved: bool = False) -> bool:
         """Checkpoint + True when a SIGTERM arrived on ANY process
@@ -419,10 +478,24 @@ class BaseRLTrainer:
         so resumed rollouts come from the restored policy, not the fresh
         init. The kill-and-continue path the reference's dead checkpointing
         never had (reference: trlx/model/__init__.py:101-129). Returns True
-        when a restore actually happened."""
+        when a restore actually happened.
+
+        ``resume_from: auto`` resolves to the newest valid checkpoint
+        under checkpoint_dir — and to a FRESH start when none exists, so
+        the same config line covers both the first launch and every
+        restart after preemption (half-written saves are skipped by
+        find_latest_checkpoint; see docs "Fault tolerance")."""
         directory = getattr(self.config.train, "resume_from", "")
         if not directory or getattr(self, "_resumed", False):
             return False
+        if directory == "auto":
+            from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+            directory = find_latest_checkpoint(
+                self.config.train.checkpoint_dir
+            )
+            if directory is None:
+                return False
         self.load(directory)
         self._resumed = True
         return True

@@ -150,6 +150,11 @@ class JaxILQLTrainer(BaseRLTrainer):
         net = self.net
         m = self.config.method
         opt = self.opt
+        # same on-device commit gate as the PPO step (see the PPO
+        # trainer's note): with train.max_bad_steps > 0 a non-finite
+        # loss/grad-norm leaves params and optimizer state untouched and
+        # only the bad_step verdict reaches the host StepGuard
+        guard_on = getattr(self.config.train, "max_bad_steps", 0) > 0
 
         def train_step(params, opt_state, batch: ILQLBatch):
             def loss_fn(trainable):
@@ -171,11 +176,24 @@ class JaxILQLTrainer(BaseRLTrainer):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params["trainable"]
             )
-            updates, opt_state = opt.update(grads, opt_state, params["trainable"])
+            updates, new_opt_state = opt.update(
+                grads, opt_state, params["trainable"]
+            )
             trainable = optax.apply_updates(params["trainable"], updates)
-            params = {**params, "trainable": trainable}
             stats["grad_norm"] = optax.global_norm(grads)
-            return params, opt_state, stats
+            if guard_on:
+                ok = jnp.isfinite(loss) & jnp.isfinite(stats["grad_norm"])
+                trainable = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    trainable, params["trainable"],
+                )
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    new_opt_state, opt_state,
+                )
+                stats["bad_step"] = 1.0 - ok.astype(jnp.float32)
+            params = {**params, "trainable": trainable}
+            return params, new_opt_state, stats
 
         beta = m.beta
         top_k = m.top_k
@@ -376,9 +394,18 @@ class JaxILQLTrainer(BaseRLTrainer):
         if len(prompts) and isinstance(prompts[0], str):
             decoded = self.tokenizer.batch_decode(samples)
         if self.reward_fn is not None:
+            from trlx_tpu.utils.faults import retry_call
+
             rewards = np.asarray(
-                self.reward_fn(decoded if decoded is not None
-                               else sample_lists),
+                retry_call(
+                    self.reward_fn,
+                    decoded if decoded is not None else sample_lists,
+                    retries=getattr(self.config.train, "host_retries", 2),
+                    backoff=getattr(
+                        self.config.train, "host_retry_backoff", 0.5
+                    ),
+                    label="reward_fn (eval)",
+                ),
                 np.float32,
             )
             logs["reward"] = float(rewards.mean())
@@ -394,7 +421,10 @@ class JaxILQLTrainer(BaseRLTrainer):
         """Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace
         of the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
         checkpoints at the next step boundary and returns cleanly
-        (train.save_on_preemption, trlx_tpu.utils.preemption)."""
+        (train.save_on_preemption, trlx_tpu.utils.preemption). With
+        train.max_bad_steps > 0, non-finite updates are skipped on device
+        and contained by rollback-to-checkpoint
+        (trlx_tpu.utils.faults.StepGuard, same containment as PPO)."""
         from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import maybe_trace
 
@@ -414,6 +444,7 @@ class JaxILQLTrainer(BaseRLTrainer):
         cfg = self.config.train
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
+        step_guard = self._make_step_guard(log_fn)
         clock = Clock()
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
 
@@ -476,6 +507,9 @@ class JaxILQLTrainer(BaseRLTrainer):
                     )
                 self.iter_count += 1
                 clock.tick(len(idx))
+                # divergence verdict (free when disabled); a rollback
+                # restores params/opt/iter_count from the last checkpoint
+                self._observe_step(step_guard, stats)
 
                 if self.iter_count % m.steps_for_target_q_sync == 0:
                     self.params = self._sync(self.params)

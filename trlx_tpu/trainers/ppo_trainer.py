@@ -220,6 +220,14 @@ class JaxPPOTrainer(BaseRLTrainer):
         opt = self.opt
         gen_config = self.gen_config
         compute = DTYPES[self.config.model.compute_dtype]
+        # divergence containment baked into the step program: with
+        # train.max_bad_steps > 0 a bad update (non-finite loss/grad-norm,
+        # or approx_kl above train.max_step_kl) is NOT committed — the
+        # select happens on device, so the donated params/opt-state buffers
+        # keep their pre-step values and the host only reads the verdict
+        # flag (trlx_tpu.utils.faults.StepGuard does the counting/rollback)
+        guard_on = getattr(self.config.train, "max_bad_steps", 0) > 0
+        max_step_kl = float(getattr(self.config.train, "max_step_kl", 0.0))
 
         logit_mask = self.logit_mask
         # decided EAGERLY on the concrete params (shardings visible) and
@@ -345,13 +353,28 @@ class JaxPPOTrainer(BaseRLTrainer):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params["trainable"]
             )
-            updates, opt_state = opt.update(
+            updates, new_opt_state = opt.update(
                 grads, opt_state, params["trainable"]
             )
             trainable = optax.apply_updates(params["trainable"], updates)
-            params = {**params, "trainable": trainable}
             stats["grad_norm"] = optax.global_norm(grads)
-            return params, opt_state, stats
+            if guard_on:
+                ok = jnp.isfinite(loss) & jnp.isfinite(stats["grad_norm"])
+                if max_step_kl > 0:
+                    ok &= stats["approx_kl"] <= max_step_kl
+                # commit-or-keep on device: a NaN update (grads poison the
+                # optimizer moments too) must not touch either tree
+                trainable = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    trainable, params["trainable"],
+                )
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    new_opt_state, opt_state,
+                )
+                stats["bad_step"] = 1.0 - ok.astype(jnp.float32)
+            params = {**params, "trainable": trainable}
+            return params, new_opt_state, stats
 
         def train_multi(params, opt_state, batch: PPORLBatch):
             """`ppo_epochs` optimization passes over one minibatch in a
@@ -370,6 +393,11 @@ class JaxPPOTrainer(BaseRLTrainer):
                 one, (params, opt_state), None, length=m.ppo_epochs
             )
             last_stats = jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
+            if guard_on:
+                # ANY bad inner pass marks the whole dispatch (each pass
+                # already self-skipped on device; the host guard counts
+                # the dispatch once)
+                last_stats["bad_step"] = stats_seq["bad_step"].max()
             return params, opt_state, last_stats
 
         def train_multi_indexed(params, opt_state, store_batch: PPORLBatch,
@@ -535,7 +563,14 @@ class JaxPPOTrainer(BaseRLTrainer):
             (out.sequences, out.gen_tokens)
         )
         texts = self.tokenizer.batch_decode(sequences, skip_special_tokens=True)
-        scores = np.asarray(self.reward_fn(texts), np.float32)
+        from trlx_tpu.utils.faults import retry_call
+
+        scores = np.asarray(retry_call(
+            self.reward_fn, texts,
+            retries=getattr(self.config.train, "host_retries", 2),
+            backoff=getattr(self.config.train, "host_retry_backoff", 0.5),
+            label="reward_fn (eval)",
+        ), np.float32)
         query_texts = self.tokenizer.batch_decode(
             np.asarray(query), skip_special_tokens=True
         )
@@ -570,7 +605,11 @@ class JaxPPOTrainer(BaseRLTrainer):
         Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace of
         the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
         checkpoints at the next step boundary and returns cleanly
-        (train.save_on_preemption, trlx_tpu.utils.preemption)."""
+        (train.save_on_preemption, trlx_tpu.utils.preemption). With
+        train.max_bad_steps > 0, non-finite / KL-breaching updates are
+        skipped on device and contained by rollback-to-checkpoint
+        (trlx_tpu.utils.faults.StepGuard); a run that re-diverges after
+        rollback raises DivergenceError instead of training on garbage."""
         from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import annotate, maybe_trace
 
@@ -579,6 +618,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         clock = Clock()
         self.maybe_resume()  # no-op when already restored at construction
+        step_guard = self._make_step_guard(log_fn)
 
         # auto poll_interval is capped so preemption-detection latency
         # stays bounded relative to eviction grace windows (a spot node
@@ -589,7 +629,8 @@ class JaxPPOTrainer(BaseRLTrainer):
             poll_interval=(cfg.preempt_poll_interval
                            or min(cfg.log_interval, 8)),
         ) as guard:
-            self._learn_loop(log_fn, cfg, m, clock, annotate, guard)
+            self._learn_loop(log_fn, cfg, m, clock, annotate, guard,
+                             step_guard)
 
     def _batch_runner(self, cfg):
         """(iterator, run, rows): one optimization-batch step per item.
@@ -644,7 +685,8 @@ class JaxPPOTrainer(BaseRLTrainer):
         end_count = self.iter_count + n_batches * m.ppo_epochs
         return end_count < cfg.total_steps and self.epoch + 1 < cfg.epochs
 
-    def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None):
+    def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None,
+                    step_guard=None):
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
             loader, run, rows = self._batch_runner(cfg)
             pending_exp = None
@@ -668,6 +710,10 @@ class JaxPPOTrainer(BaseRLTrainer):
                     self.params, self.opt_state, stats = run(item)
                     self.iter_count += m.ppo_epochs
                 clock.tick(rows(item) * m.ppo_epochs)
+                # divergence verdict (no-op sync-free when disabled); a
+                # rollback here restores params/opt/iter_count from the
+                # last checkpoint and the loop simply keeps going
+                self._observe_step(step_guard, stats)
 
                 intervals = self.intervals(self.iter_count)
                 if intervals["do_log"]:

@@ -25,8 +25,14 @@ def formats_of(tree):
     """Per-leaf ``Format`` pytree of concrete arrays — pass as (part of)
     ``out_shardings`` to pin a jit's output layouts to its inputs'
     (donated pass-through subtrees keep their custom at-rest layouts
-    instead of silently reverting to XLA's defaults)."""
-    return jax.tree_util.tree_map(lambda x: x.format, tree)
+    instead of silently reverting to XLA's defaults).
+
+    ``Array.format`` is the jax >= 0.5 spelling; 0.4.x exposes the same
+    (layout, sharding) pair as ``Array.layout``, which jit accepts in the
+    same positions."""
+    return jax.tree_util.tree_map(
+        lambda x: getattr(x, "format", None) or x.layout, tree
+    )
 
 
 def _leaf_sig(x):
@@ -39,8 +45,15 @@ def _leaf_sig(x):
             return ("py", type(x), x)
         except TypeError:
             return ("py", type(x), repr(x))
-    fmt = getattr(x, "format", None)
-    layout = getattr(getattr(fmt, "layout", None), "major_to_minor", None)
+    fmt = getattr(x, "format", None) or getattr(x, "layout", None)
+    layout = getattr(
+        # .layout on a Format (jax >= 0.5), .device_local_layout on the
+        # 0.4.x Layout object — same major_to_minor payload either way
+        getattr(fmt, "layout", None)
+        or getattr(fmt, "device_local_layout", None),
+        "major_to_minor",
+        None,
+    )
     # sharding must join the key: the compiled call path validates arg
     # shardings STRICTLY (plain jit would silently reshard), so an arg
     # whose sharding drifted — e.g. optimizer moments coming back from an

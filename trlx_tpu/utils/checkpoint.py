@@ -1,21 +1,50 @@
-"""Orbax-backed component checkpointing — actually wired to training.
+"""Crash-atomic, self-managing component checkpointing.
 
 The reference declares checkpoint_interval and computes do_save but never
 calls save() from either learn loop, and its save/load swallows exceptions
 (reference: trlx/model/__init__.py:101-129, SURVEY §3.6). Here save/restore
-is explicit and raises on failure, and the trainers call it on the
-configured interval.
+is explicit and raises on failure, the trainers call it on the configured
+interval, and — because the whole point of checkpointing is surviving
+preemption — the save itself survives being preempted:
 
-Components are a flat dict {name: pytree | scalar-dict}; arrays go through
-Orbax, plain-python metadata through JSON.
+- ``save_components`` writes into a ``<dir>.tmp-<suffix>`` staging
+  directory and commits with ``os.replace``. A process killed mid-save
+  leaves a dead staging directory and an intact previous checkpoint; it
+  can NEVER leave a half-written directory under the final name.
+- ``meta.json`` (plain-python components, also the commit marker — it is
+  written last) goes through its own write-temp-then-``os.replace``.
+- Step checkpoints (``save_step_checkpoint``) live under a run directory
+  as ``step_<N>/`` with an atomically-updated ``LATEST`` marker;
+  ``find_latest_checkpoint`` resolves the newest VALID one (skipping
+  staging leftovers and dirs missing the commit marker), which is what
+  ``train.resume_from: auto`` resumes from. ``train.keep_checkpoints``
+  bounds disk: older committed step dirs (and dead staging dirs) are
+  garbage-collected after each successful save.
+- ``restore_components`` accepts either a checkpoint dir or a run dir
+  (falling back to the newest valid step inside), and raises ONE
+  actionable error — expected components vs. what is actually on disk —
+  instead of a bare per-component FileNotFoundError.
+
+Only JAX process 0 writes (single-writer; params are replicated or
+re-shardable on restore) — gated HERE, not at call sites, so every save
+path inherits it. Components are a flat dict {name: pytree | scalar-dict};
+arrays go through Orbax, plain-python metadata through JSON.
 """
 
 import json
 import os
-from typing import Any, Dict
+import re
+import shutil
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+#: commit marker: always written, and written LAST — a directory without
+#: it is a torn write, not a checkpoint
+META_NAME = "meta.json"
+LATEST_NAME = "LATEST"
+_STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 def _is_array_tree(obj: Any) -> bool:
@@ -25,34 +54,228 @@ def _is_array_tree(obj: Any) -> bool:
     )
 
 
+def _has_empty_leaf(obj: Any) -> bool:
+    """Any zero-size array leaf — e.g. ILQL's ``frozen_base.blocks`` at
+    ``num_layers_unfrozen: -1`` (everything trainable, zero frozen
+    layers). Orbax's ocdbt backend writes nothing for them and then fails
+    its own post-save validation ("N params are missing in checkpoint");
+    such trees go through the per-param (non-ocdbt) writer, whose format
+    the default reader restores transparently."""
+    return any(
+        getattr(x, "size", 1) == 0 for x in jax.tree_util.tree_leaves(obj)
+    )
+
+
+def _main_process() -> bool:
+    from trlx_tpu.parallel import is_main_process
+
+    return is_main_process()
+
+
+def _atomic_write_text(text: str, path: str) -> None:
+    """write-temp-then-rename: readers see the old content or the new,
+    never a torn write (a preemption mid-``json.dump`` previously left a
+    truncated meta.json under the final name)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def is_valid_checkpoint(directory: str) -> bool:
+    """Committed checkpoint dir: exists, is not a staging/aside leftover,
+    and carries the commit marker (meta.json, written last)."""
+    base = os.path.basename(os.path.normpath(directory))
+    if ".tmp-" in base or ".old-" in base:
+        return False
+    return os.path.isdir(directory) and os.path.exists(
+        os.path.join(directory, META_NAME)
+    )
+
+
 def save_components(components: Dict[str, Any], directory: str) -> None:
+    """Write all components under ``directory``, crash-atomically.
+
+    Everything lands in a ``<directory>.tmp-<pid>`` staging dir first
+    (arrays via Orbax, then meta.json as the commit marker); the final
+    name appears only via ``os.replace``. Replacing an existing
+    checkpoint renames it aside first, so a crash at any instant leaves
+    either the old committed dir or the new one reachable — never a
+    partial mix. No-op off JAX process 0 (single-writer)."""
+    if not _main_process():
+        return
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    os.makedirs(directory, exist_ok=True)
+    parent = os.path.dirname(directory)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    staging = f"{directory}.tmp-{os.getpid()}"
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)  # leftover from a previous crashed save
+    os.makedirs(staging)
     meta = {}
-    with ocp.PyTreeCheckpointer() as ckptr:
+    with ocp.PyTreeCheckpointer() as ckptr, ocp.PyTreeCheckpointer(
+        use_ocdbt=False
+    ) as plain_ckptr:
         for name, obj in components.items():
             if _is_array_tree(obj):
-                path = os.path.join(directory, name)
-                ckptr.save(path, obj, force=True)
+                writer = plain_ckptr if _has_empty_leaf(obj) else ckptr
+                writer.save(os.path.join(staging, name), obj, force=True)
             else:
                 meta[name] = obj
-    with open(os.path.join(directory, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    # the commit marker: written last, atomically, inside staging
+    _atomic_write_text(json.dumps(meta), os.path.join(staging, META_NAME))
+
+    if os.path.isdir(directory):
+        # rename-aside then promote: os.replace cannot replace a
+        # non-empty dir, and deleting the old checkpoint BEFORE the new
+        # one is committed would reopen the exact corruption window this
+        # module exists to close
+        aside = f"{directory}.old-{os.getpid()}"
+        if os.path.isdir(aside):
+            shutil.rmtree(aside)
+        os.replace(directory, aside)
+        os.replace(staging, directory)
+        shutil.rmtree(aside)
+    else:
+        os.replace(staging, directory)
+
+
+def step_dir(run_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(run_dir), f"step_{int(step)}")
+
+
+def find_latest_checkpoint(run_dir: str) -> Optional[str]:
+    """Newest VALID ``step_<N>`` checkpoint under ``run_dir``, or None.
+
+    Prefers the atomically-written LATEST marker when it points at a
+    valid dir; otherwise scans — half-written dirs (dead staging, torn
+    writes missing the commit marker) are skipped, so a save killed
+    mid-write falls back to the previous committed step."""
+    run_dir = os.path.abspath(run_dir)
+    if not os.path.isdir(run_dir):
+        return None
+    latest_path = os.path.join(run_dir, LATEST_NAME)
+    if os.path.exists(latest_path):
+        with open(latest_path) as f:
+            named = os.path.join(run_dir, f.read().strip())
+        if is_valid_checkpoint(named):
+            return named
+    best = None
+    best_step = -1
+    for entry in os.listdir(run_dir):
+        m = _STEP_RE.match(entry)
+        if not m:
+            continue
+        path = os.path.join(run_dir, entry)
+        if int(m.group(1)) > best_step and is_valid_checkpoint(path):
+            best, best_step = path, int(m.group(1))
+    return best
+
+
+def gc_checkpoints(run_dir: str, keep: int) -> None:
+    """Retention: delete all but the newest ``keep`` committed step dirs
+    (``keep <= 0`` keeps everything), plus any dead staging/aside
+    leftovers from crashed saves. Invalid step dirs are removed too —
+    they are torn writes, not restorable state."""
+    run_dir = os.path.abspath(run_dir)
+    if not os.path.isdir(run_dir):
+        return
+    steps = []
+    for entry in os.listdir(run_dir):
+        path = os.path.join(run_dir, entry)
+        if ".tmp-" in entry or ".old-" in entry:
+            shutil.rmtree(path, ignore_errors=True)
+            continue
+        m = _STEP_RE.match(entry)
+        if not m:
+            continue
+        if not is_valid_checkpoint(path):
+            shutil.rmtree(path, ignore_errors=True)
+            continue
+        steps.append((int(m.group(1)), path))
+    if keep and keep > 0:
+        for _, path in sorted(steps)[:-keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+def save_step_checkpoint(
+    components: Dict[str, Any], run_dir: str, step: int, keep: int = 0
+) -> str:
+    """One training-step checkpoint under ``run_dir/step_<step>``:
+    atomic component save, LATEST marker update (also atomic), then
+    retention GC. Returns the checkpoint path. No-op (path still
+    returned) off JAX process 0."""
+    path = step_dir(run_dir, step)
+    if not _main_process():
+        return path
+    save_components(components, path)
+    _atomic_write_text(
+        os.path.basename(path), os.path.join(os.path.dirname(path), LATEST_NAME)
+    )
+    gc_checkpoints(run_dir, keep)
+    return path
+
+
+def _resolve_restore_dir(directory: str) -> Optional[str]:
+    """A directory the user can point restore at: a checkpoint itself, or
+    a run dir whose newest valid step checkpoint is used."""
+    if is_valid_checkpoint(directory):
+        return directory
+    return find_latest_checkpoint(directory)
 
 
 def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, Any]:
-    """Restore into the structure of `template` (same component names/shapes)."""
+    """Restore into the structure of `template` (same component names/shapes).
+
+    `directory` may be a single checkpoint or a run dir of ``step_<N>``
+    checkpoints (the newest valid one is used — half-written ones are
+    skipped). Missing paths/components raise ONE error naming what was
+    expected and what is actually on disk, instead of a bare
+    per-component FileNotFoundError."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
+    resolved = _resolve_restore_dir(directory)
+    if resolved is None:
+        if os.path.isdir(directory):
+            contents = sorted(os.listdir(directory)) or ["<empty>"]
+            detail = f"exists but holds no committed checkpoint: {contents}"
+        else:
+            detail = "does not exist"
+        raise FileNotFoundError(
+            f"no checkpoint at '{directory}' ({detail}). Expected either a "
+            f"checkpoint directory with components "
+            f"{sorted(template)} + '{META_NAME}', or a run directory "
+            f"containing committed 'step_<N>' checkpoints. A save killed "
+            f"mid-write leaves only a '*.tmp-*' staging dir — that is not "
+            f"restorable; point resume_from at the run directory (or "
+            f"'auto') to fall back to the newest committed step."
+        )
+    directory = resolved
     out = {}
-    meta_path = os.path.join(directory, "meta.json")
+    meta_path = os.path.join(directory, META_NAME)
     meta = {}
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    missing = [
+        name
+        for name in template
+        if not os.path.isdir(os.path.join(directory, name)) and name not in meta
+    ]
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint '{directory}' is missing components {missing}: "
+            f"expected {sorted(template)}, found on disk "
+            f"{sorted(os.listdir(directory))} with meta keys "
+            f"{sorted(meta)}. The checkpoint was probably written by a "
+            f"different trainer/method — components must match the "
+            f"restoring trainer's get_components()."
+        )
     with ocp.PyTreeCheckpointer() as ckptr:
         for name, obj in template.items():
             path = os.path.join(directory, name)
@@ -66,10 +289,6 @@ def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, An
                 out[name] = ckptr.restore(
                     path, item=obj, restore_args=restore_args
                 )
-            elif name in meta:
-                out[name] = meta[name]
             else:
-                raise FileNotFoundError(
-                    f"component '{name}' not found in checkpoint {directory}"
-                )
+                out[name] = meta[name]
     return out

@@ -1,0 +1,165 @@
+"""Divergence containment and bounded host-side fault tolerance.
+
+Long unattended RLHF runs fail in two characteristic ways the debug-only
+``train.debug_nans`` flag (fail-fast at the first non-finite op, SURVEY §5)
+is exactly wrong for:
+
+- **Numerical divergence.** Ziegler-style KL-penalty PPO silently blows up
+  (NaN loss, exploding grad norm, runaway KL) and then happily trains on
+  garbage for the rest of the job's walltime. The trainers bake a commit
+  gate into the jitted step — a step whose loss/grad-norm is non-finite
+  (or whose policy KL breaches ``train.max_step_kl``) leaves params and
+  optimizer state UNCHANGED on device — and report a ``bad_step`` flag the
+  host-side :class:`StepGuard` counts: ``train.max_bad_steps`` consecutive
+  bad steps trigger a rollback to the last checkpoint, and a second strike
+  aborts with a diagnostic instead of burning the rest of the reservation.
+- **Flaky host seams.** User ``reward_fn`` callbacks (HF pipelines,
+  scoring services) and tracker emissions (wandb over a flaky network) sit
+  OUTSIDE the jitted world and fail transiently. :func:`retry_call` gives
+  them bounded retry-with-backoff; trackers additionally degrade to stdout
+  (trlx_tpu.utils.trackers.ResilientTracker) rather than killing the run.
+"""
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged beyond what rollback can contain. Carries the
+    full containment history in the message — this is the error an
+    operator reads in a log three days after the run died."""
+
+
+def retry_call(
+    fn: Callable,
+    *args: Any,
+    retries: int = 2,
+    backoff: float = 0.5,
+    label: str = "",
+    log: Callable[[str], None] = print,
+    **kwargs: Any,
+):
+    """``fn(*args, **kwargs)`` with up to ``retries`` retries on exception,
+    exponential backoff between attempts (``backoff * 2**attempt`` seconds),
+    and the LAST exception re-raised when the budget is exhausted — a
+    persistently-broken seam must still fail loudly, just not on its first
+    hiccup. ``retries=0`` is a plain call."""
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = backoff * (2 ** (attempt - 1))
+            log(
+                f"[trlx_tpu] {label or getattr(fn, '__name__', 'call')} "
+                f"failed ({type(e).__name__}: {e}); retry "
+                f"{attempt}/{retries} in {delay:.2g}s"
+            )
+            if delay > 0:
+                time.sleep(delay)
+
+
+class StepGuard:
+    """Host-side divergence containment for a learn loop.
+
+    The trainers' jitted steps already refuse to commit a bad update
+    (non-finite loss/grad-norm, KL breach — the ``bad_step`` stat); the
+    guard turns the resulting *stream* of verdicts into policy:
+
+    - a bad step is counted and logged (the step was already skipped on
+      device: params/opt-state untouched);
+    - ``max_bad_steps`` CONSECUTIVE bad steps trigger ``rollback_fn``
+      (restore the last checkpoint); any good step resets the streak;
+    - ``max_rollbacks`` exhausted — the second strike — raises
+      :class:`DivergenceError` with the full history, because a run that
+      re-diverges straight out of its last good checkpoint will not be
+      saved by a third try, only by different hyperparameters.
+
+    ``max_bad_steps <= 0`` disables the guard entirely (``enabled`` is
+    False and the trainers skip the per-step host sync the verdict
+    fetch costs — reference-parity fast path).
+    """
+
+    def __init__(
+        self,
+        max_bad_steps: int = 0,
+        rollback_fn: Optional[Callable[[], Optional[str]]] = None,
+        max_rollbacks: int = 1,
+        log: Callable[[Dict[str, Any]], None] = None,
+    ):
+        self.max_bad_steps = int(max_bad_steps)
+        self.rollback_fn = rollback_fn
+        self.max_rollbacks = int(max_rollbacks)
+        self.log = log or (lambda stats: print(stats, flush=True))
+        self.bad_streak = 0
+        self.total_bad = 0
+        self.rollbacks = 0
+        self._history = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bad_steps > 0
+
+    def observe(self, bad: bool, step: int, detail: Optional[Dict] = None) -> str:
+        """Record one step verdict; returns "ok", "skipped", or
+        "rollback". Raises :class:`DivergenceError` on the second strike
+        (or when rollback is needed but impossible)."""
+        if not self.enabled or not bad:
+            self.bad_streak = 0
+            return "ok"
+        self.bad_streak += 1
+        self.total_bad += 1
+        self._history.append((int(step), dict(detail or {})))
+        self.log(
+            {
+                "iter": step,
+                "skipped_step": 1.0,
+                "bad_streak": self.bad_streak,
+                **{k: v for k, v in (detail or {}).items()},
+            }
+        )
+        if self.bad_streak < self.max_bad_steps:
+            return "skipped"
+        if self.rollbacks >= self.max_rollbacks:
+            raise DivergenceError(self._diagnostic(step, detail, strike=True))
+        restored = self.rollback_fn() if self.rollback_fn else None
+        if restored is None:
+            raise DivergenceError(self._diagnostic(step, detail, strike=False))
+        self.rollbacks += 1
+        self.bad_streak = 0
+        self.log(
+            {"iter": step, "rollback": 1.0, "restored_from": str(restored)}
+        )
+        return "rollback"
+
+    def _diagnostic(self, step, detail, strike: bool) -> str:
+        recent = ", ".join(
+            f"step {s}: " + " ".join(f"{k}={v:.4g}" if isinstance(v, float)
+                                     else f"{k}={v}" for k, v in d.items())
+            for s, d in self._history[-5:]
+        ) or "no per-step detail recorded"
+        if strike:
+            cause = (
+                f"{self.bad_streak} consecutive bad steps AGAIN after "
+                f"{self.rollbacks} rollback(s) to the last checkpoint"
+            )
+        else:
+            cause = (
+                f"{self.bad_streak} consecutive bad steps and no "
+                f"checkpoint to roll back to (save one before the run "
+                f"diverges: train.checkpoint_interval)"
+            )
+        return (
+            f"training diverged at iter {step}: {cause}. "
+            f"{self.total_bad} bad steps total; recent: [{recent}]. "
+            f"Bad = non-finite loss/grad-norm or KL above "
+            f"train.max_step_kl; the skipped updates never touched "
+            f"params, so the model state equals the last good step. "
+            f"Likely fixes: lower learning_rate_init, raise grad_clip "
+            f"aggressiveness, lower max_step_kl tolerance, or inspect "
+            f"the reward scale. Re-run with train.debug_nans: true to "
+            f"fail at the first non-finite op."
+        )

@@ -116,6 +116,58 @@ class JsonlTracker:
         pass
 
 
+class ResilientTracker:
+    """Fault isolation for metric sinks: an emission failure is retried
+    (bounded, trlx_tpu.utils.faults.retry_call), and a PERSISTENTLY
+    failing sink — `max_consecutive_failures` emissions in a row lost
+    despite retries — degrades to PrintTracker with a warning. Metrics
+    are telemetry; losing their transport must never kill a training run
+    (the reference's exception-swallowing went too far the other way and
+    hid real bugs — here every failure is printed, the run just doesn't
+    die)."""
+
+    def __init__(self, inner, retries: int = 1, backoff: float = 0.5,
+                 max_consecutive_failures: int = 3,
+                 fallback_factory=PrintTracker):
+        self.inner = inner
+        self.retries = retries
+        self.backoff = backoff
+        self.max_consecutive_failures = max_consecutive_failures
+        self.fallback_factory = fallback_factory
+        self.failures = 0
+        self.degraded = False
+
+    def __call__(self, stats: Dict[str, Any]) -> None:
+        from trlx_tpu.utils.faults import retry_call
+
+        if self.degraded:
+            self.inner(stats)
+            return
+        try:
+            retry_call(self.inner, stats, retries=self.retries,
+                       backoff=self.backoff, label="tracker emission")
+            self.failures = 0
+        except Exception as e:
+            self.failures += 1
+            print(f"[trlx_tpu] tracker emission lost after retries "
+                  f"({type(e).__name__}: {e}); "
+                  f"{self.failures}/{self.max_consecutive_failures} "
+                  f"consecutive failures", flush=True)
+            if self.failures >= self.max_consecutive_failures:
+                print("[trlx_tpu] tracker persistently failing; degrading "
+                      "to stdout for the rest of the run", flush=True)
+                self.degraded = True
+                self.inner = self.fallback_factory()
+                self.inner(stats)
+
+    def finish(self) -> None:
+        try:
+            self.inner.finish()
+        except Exception as e:
+            print(f"[trlx_tpu] tracker finish failed ({e!r}); ignored",
+                  flush=True)
+
+
 class MultiTracker:
     def __init__(self, *trackers):
         self.trackers = [t for t in trackers if t is not None]
@@ -135,26 +187,35 @@ def make_tracker(config=None, kind: Optional[str] = None):
     `kind` (or `config.train.tracker`): "wandb", "print", "none"/None, or a
     "jsonl:<path>" spec. "wandb" degrades to print with a notice when the
     package is missing or init fails (e.g. no network) — a missing tracker
-    must never kill a training run. Non-main processes always get a no-op
-    (parity: main-process-only tracker init,
+    must never kill a training run — and a wandb/jsonl sink that starts
+    failing MID-RUN is retried then degraded to stdout the same way
+    (ResilientTracker; retry budget from train.host_retries). Non-main
+    processes always get a no-op (parity: main-process-only tracker init,
     accelerate_base_model.py:58-61)."""
     from trlx_tpu.parallel import is_main_process
 
     if not is_main_process():
         return _NULL
 
-    kind = kind if kind is not None else getattr(
-        getattr(config, "train", None), "tracker", "print"
-    )
+    train = getattr(config, "train", None)
+    kind = kind if kind is not None else getattr(train, "tracker", "print")
+
+    def resilient(inner):
+        return ResilientTracker(
+            inner,
+            retries=getattr(train, "host_retries", 1),
+            backoff=getattr(train, "host_retry_backoff", 0.5),
+        )
+
     if kind in (None, "none", ""):
         return _NULL
     if isinstance(kind, str) and kind.startswith("jsonl:"):
-        return JsonlTracker(kind.split(":", 1)[1])
+        return resilient(JsonlTracker(kind.split(":", 1)[1]))
     if kind == "wandb":
-        project = getattr(getattr(config, "train", None), "project_name", "")
+        project = getattr(train, "project_name", "")
         cfg_dict = config.to_dict() if hasattr(config, "to_dict") else None
         try:
-            return WandbTracker(project, cfg_dict)
+            return resilient(WandbTracker(project, cfg_dict))
         except Exception as e:  # missing package, offline, auth failure
             print(f"[trlx_tpu] wandb tracker unavailable ({e!r}); "
                   f"falling back to stdout", flush=True)
