@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults
+.PHONY: style check test faults telemetry
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -24,3 +24,11 @@ test:
 faults:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py \
 		tests/test_checkpoint.py -q
+
+# observability tier: metrics-registry semantics, span tracing +
+# Chrome-trace JSONL validity, fault-counter wiring, tracker fixes, and
+# the CPU smoke learn() emission (time/*, throughput/*, fault/* keys +
+# telemetry.json / trace.jsonl). Part of the non-slow tier-1 set.
+telemetry:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+		tests/test_trackers.py -q

@@ -32,13 +32,14 @@ os.environ.setdefault("HF_HUB_OFFLINE", "1")
 
 import numpy as np
 
-# bf16 peak matmul throughput per chip, by TPU generation
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12 / 2,  # 197 TOPS int8 => ~98.5 TFLOP/s bf16
-    "v5p": 459e12,
-    "v6e": 918e12 / 2,
-}
+# analytic flops + per-generation peaks now live in the telemetry
+# subsystem (trlx_tpu/telemetry/flops.py) — the learn loops' MFU emission
+# and this bench divide by the same numbers
+from trlx_tpu.telemetry.flops import (
+    PEAK_FLOPS,
+    decode_flops_per_token,
+    ppo_train_flops_per_token as model_flops_per_train_token,
+)
 
 
 def log(msg):
@@ -130,28 +131,6 @@ def build():
         chunk_size=config.method.chunk_size,
     )
     return config, trainer, pipeline, orch
-
-
-def model_flops_per_train_token(spec, num_layers_unfrozen):
-    """Matmul flops per (batch x seq) token of one PPO optimization step.
-
-    Forward runs the full depth; backward only reaches the trainable top
-    (gradients stop at the frozen-trunk boundary — the hydra split).
-    Attention quadratic terms are excluded (T=52 makes them negligible
-    against d_model=768 projections); this slightly UNDERSTATES flops, so
-    MFU is conservative.
-    """
-    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
-    per_layer = 2 * (4 * d * d + 2 * d * f)  # qkv+o projections, mlp in/out
-    fwd = L * per_layer + 2 * d * V  # + logits projection
-    k = num_layers_unfrozen if num_layers_unfrozen >= 0 else L
-    bwd = 2 * (k * per_layer + 2 * d * V)
-    return fwd + bwd
-
-
-def decode_flops_per_token(spec):
-    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
-    return L * 2 * (4 * d * d + 2 * d * f) + 2 * d * V
 
 
 def previous_round_value(metric):

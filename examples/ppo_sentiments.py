@@ -59,9 +59,15 @@ def offline_pieces(config):
     config.model.compute_dtype = "float32"
     config.train.epochs = 6
     config.train.total_steps = 200
+    # always leave the observability record behind, even if this demo is
+    # killed before its first checkpoint creates the run dir
+    config.train.telemetry_dir = config.train.checkpoint_dir
     # save often enough that a killed demo run has something to resume
     # from (the YAML's resume_from: auto picks it up on the next launch)
     config.train.checkpoint_interval = 50
+    # per-iteration observability (time/* breakdown, throughput/*,
+    # fault/*) every 4 steps — the demo run is short
+    config.train.log_interval = 4
     config.train.batch_size = 64
     config.method.num_rollouts = 64
     config.method.chunk_size = 64
@@ -115,6 +121,12 @@ def main():
     info = orch.make_experience(config.method.num_rollouts)
     print({"rollout": info})
     trainer.learn()
+    # the learn loop logged time/* / throughput/* / fault/* per interval
+    # and left telemetry.json + trace.jsonl (open in https://ui.perfetto.dev)
+    # in the run dir — see docs/source/observability.rst
+    run_dir = config.train.telemetry_dir or config.train.checkpoint_dir
+    print(f"observability record (telemetry.json + Perfetto trace.jsonl) "
+          f"under {run_dir!r}")
 
 
 if __name__ == "__main__":

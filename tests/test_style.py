@@ -6,12 +6,15 @@ Covers the highest-signal subset of the configured ruff rules
 imports (F401, minus `# noqa` re-export shims), no tabs in indentation,
 no trailing whitespace, and no `== None` / `!= None` comparisons (E711).
 
-Library-only rule (trlx_tpu/): no bare ``except:`` and no
+Library-only rules (trlx_tpu/): no bare ``except:`` and no
 exception-swallowing ``except ...: pass`` handlers. The reference's
 checkpoint save/load wrapped everything in try/except-pass — which is
 exactly how its checkpointing shipped dead and nobody noticed (SURVEY
 §3.6). A handler must re-raise, return, log, or otherwise DO something
-with the failure.
+with the failure. And no ad-hoc ``time.time()`` / ``time.perf_counter()``
+deltas outside ``utils/__init__.py`` (Clock) and ``telemetry/`` — all new
+timing goes through the telemetry registry so it reaches the metrics
+stream instead of dying in a local variable.
 """
 
 import ast
@@ -87,7 +90,32 @@ def test_lint(path):
         if stripped[: len(stripped) - len(stripped.lstrip())].count("\t"):
             problems.append(f"line {i}: tab in indentation (W191)")
 
-    if (REPO / "trlx_tpu") in path.parents:
+    lib = REPO / "trlx_tpu"
+    if lib in path.parents:
+        # all timing goes through Clock (utils/__init__.py) or the
+        # telemetry registry/tracer: ad-hoc time.time()/perf_counter()
+        # deltas are exactly the opaque instrumentation the unified
+        # telemetry layer replaced (docs "Observability")
+        timing_allowed = (
+            path == lib / "utils" / "__init__.py"
+            or (lib / "telemetry") in path.parents
+        )
+        if not timing_allowed:
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("time", "perf_counter",
+                                           "monotonic")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"
+                ):
+                    problems.append(
+                        f"line {node.lineno}: ad-hoc time.{node.func.attr}"
+                        f"() timing — use trlx_tpu.telemetry.span()/"
+                        f"observe() (or utils.Clock) so the measurement "
+                        f"reaches the metrics stream"
+                    )
         for node in ast.walk(tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue

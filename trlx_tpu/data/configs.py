@@ -182,6 +182,9 @@ class TrainConfig:
     :param max_step_kl: PPO per-step policy-KL bound counted as bad
     :param host_retries / host_retry_backoff: bounded retry for host
         seams (reward_fn, trackers)
+    :param telemetry / telemetry_dir: unified metrics/span telemetry
+        (trlx_tpu.telemetry) — per-iteration time/* / throughput/* /
+        fault/* keys and a telemetry.json + trace.jsonl at learn() exit
     """
 
     n_ctx: int
@@ -279,6 +282,18 @@ class TrainConfig:
     # eviction grace windows). Lower it (e.g. 1) when single steps are
     # slow enough that 8 of them outlast your scheduler's SIGTERM grace.
     preempt_poll_interval: int = 0
+    # unified telemetry (trlx_tpu.telemetry, docs "Observability"): the
+    # learn loops emit per-iteration time/* phase durations, throughput/*
+    # (tokens/sec, samples/sec, MFU), fault/* counters, and device/* HBM
+    # gauges through the configured tracker, and write a telemetry.json
+    # summary + Chrome-trace/Perfetto trace.jsonl at learn() exit. False
+    # disables the whole subsystem — zero records, zero overhead (the
+    # reference-parity metrics stream).
+    telemetry: bool = True
+    # where telemetry.json / trace.jsonl land. "" = checkpoint_dir, and
+    # then only written when that directory exists (a checkpoint has been
+    # committed); an explicit path is always created and written.
+    telemetry_dir: str = ""
     debug_nans: bool = False
 
     @classmethod

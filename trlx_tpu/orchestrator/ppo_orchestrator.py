@@ -169,7 +169,21 @@ class PPOOrchestrator(Orchestrator):
         """Harvest the rollouts start_experience dispatched: per chunk, ONE
         (sequences, seq_kl[, device-RM scores]) fetch, host (or device-RM)
         scoring, reward finalization riding the dispatch back, store push;
-        then the adaptive-KL update from the measured mean KL."""
+        then the adaptive-KL update from the measured mean KL.
+
+        The harvest runs inside a ``rollout`` telemetry span (and each
+        host scoring call inside a nested ``reward_fn`` span): because the
+        dispatches are async, the harvest's fetches absorb the device
+        generation time, so ``time/rollout`` is the cycle's experience
+        phase (trlx_tpu.telemetry; no-op when disabled)."""
+        from trlx_tpu import telemetry
+
+        with telemetry.span("rollout"):
+            return self._finish_experience(handle)
+
+    def _finish_experience(self, handle):
+        from trlx_tpu import telemetry
+
         trainer = self.rl_model
         n_chunks = handle["n_chunks"]
 
@@ -210,7 +224,8 @@ class PPOOrchestrator(Orchestrator):
                 texts = trainer.tokenizer.batch_decode(
                     sequences, skip_special_tokens=True
                 )
-                scores = self.score(texts)
+                with telemetry.span("reward_fn"):
+                    scores = self.score(texts)
             all_scores.append(scores)
 
             # score lands on each row's last REAL response token (parity:

@@ -1,0 +1,42 @@
+"""Device monitor: HBM occupancy gauges from ``memory_stats()``.
+
+Sampled at metric-emission boundaries (not per step): ``memory_stats()``
+is a cheap local call on directly-attached runtimes, but tunneled/remote
+runtimes may not expose it at all — the first failure latches and the
+monitor stays silent for the rest of the process instead of re-raising
+(or re-trying) on every log interval.
+"""
+
+from trlx_tpu.telemetry.registry import MetricsRegistry
+
+_available = True  # latches False on the first failed sample
+
+_GAUGES = {
+    "bytes_in_use": "device/hbm_in_use_gb",
+    "peak_bytes_in_use": "device/hbm_peak_gb",
+    "bytes_limit": "device/hbm_limit_gb",
+}
+
+
+def sample_device_stats(registry: MetricsRegistry) -> None:
+    global _available
+    if not _available:
+        return
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        _available = False
+        return
+    if not stats:
+        _available = False
+        return
+    for key, gauge in _GAUGES.items():
+        if key in stats:
+            registry.set_gauge(gauge, stats[key] / 2**30)
+    if stats.get("bytes_limit"):
+        registry.set_gauge(
+            "device/hbm_utilization",
+            stats.get("bytes_in_use", 0) / stats["bytes_limit"],
+        )

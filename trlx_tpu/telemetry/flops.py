@@ -1,0 +1,78 @@
+"""Analytic model FLOPs + hardware peak: the MFU denominator/numerator.
+
+The standard yardstick for "as fast as the hardware allows" is model
+FLOPs utilization — achieved matmul flops over the chip's peak (the
+hardware-utilization accounting popularized by PaLM-scale training
+reports). These helpers are shared by the learn loops' per-iteration
+``throughput/mfu`` estimate and by bench.py (which previously kept its
+own copies); one formula, one place.
+
+All estimates count matmul flops only and exclude the attention
+quadratic terms (negligible against the projections at the short
+RLHF sequence lengths these loops run); they slightly UNDERSTATE flops,
+so MFU is conservative.
+"""
+
+import os
+from typing import Optional
+
+#: bf16 peak matmul throughput per chip, by TPU generation
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12 / 2,  # 197 TOPS int8 => ~98.5 TFLOP/s bf16
+    "v5p": 459e12,
+    "v6e": 918e12 / 2,
+}
+
+
+def peak_flops() -> Optional[float]:
+    """Per-chip bf16 peak for the current TPU generation, or None when the
+    generation is unknown (CPU tests, unrecognized hardware) — callers
+    then simply omit the MFU figure rather than report a wrong one."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    return PEAK_FLOPS.get(gen)
+
+
+def ppo_train_flops_per_token(spec, num_layers_unfrozen: int) -> int:
+    """Matmul flops per (batch x seq) token of one PPO optimization step.
+
+    Forward runs the full depth; backward only reaches the trainable top
+    (gradients stop at the frozen-trunk boundary — the hydra split).
+    """
+    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+    per_layer = 2 * (4 * d * d + 2 * d * f)  # qkv+o projections, mlp in/out
+    fwd = L * per_layer + 2 * d * V  # + logits projection
+    k = num_layers_unfrozen if num_layers_unfrozen >= 0 else L
+    bwd = 2 * (k * per_layer + 2 * d * V)
+    return fwd + bwd
+
+
+def decode_flops_per_token(spec) -> int:
+    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+    return L * 2 * (4 * d * d + 2 * d * f) + 2 * d * V
+
+
+def ilql_train_flops_per_token(
+    spec, num_layers_unfrozen: int, two_qs: bool = True
+) -> int:
+    """Matmul flops per token of one ILQL step: trunk forward + the
+    vocab-wide LM/Q/target-Q/V head projections, backward through the
+    trainable top + LM/Q/V heads (target-Q copies are frozen)."""
+    d, f, L, V = spec.d_model, spec.d_ff, spec.n_layer, spec.vocab_size
+    n_q = 2 if two_qs else 1
+    per_layer = 2 * (4 * d * d + 2 * d * f)
+    heads_fwd = (1 + 2 * n_q) * 2 * d * V + 2 * d  # lm + q + target_q, v
+    k = num_layers_unfrozen if num_layers_unfrozen >= 0 else L
+    fwd = L * per_layer + heads_fwd
+    bwd = 2 * (k * per_layer + (1 + n_q) * 2 * d * V + 2 * d)
+    return fwd + bwd
+
+
+def mfu_estimate(
+    tokens_per_sec: float, flops_per_token: float
+) -> Optional[float]:
+    """Achieved / peak flops, or None when either side is unknown."""
+    peak = peak_flops()
+    if not peak or not flops_per_token or not tokens_per_sec:
+        return None
+    return tokens_per_sec * flops_per_token / peak

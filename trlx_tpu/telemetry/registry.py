@@ -1,0 +1,123 @@
+"""Process-local metrics registry: counters, gauges, timing histograms.
+
+The reference's only instrumentation is the hand-rolled ``Clock`` (SURVEY
+§5 "tracing: minimal") — a production run emits opaque flat dicts with no
+notion of where the time, memory, or failures went. This registry is the
+single accumulation point every subsystem reports into:
+
+- **counters** are monotonic event tallies (``fault/skipped_steps``,
+  ``checkpoint/saves``) — ``inc`` only;
+- **gauges** are last-value-wins samples (``device/hbm_in_use_gb``,
+  ``compile/ppo_update_first_s``);
+- **timing histograms** accumulate span durations per phase name
+  (``time/rollout``) with p50/p95/max over a bounded window, plus the
+  FIRST observation kept separately — on a jitted phase the first call
+  includes tracing + XLA compilation, so ``first`` vs the steady-state
+  p50 is the compile-cache-miss signal.
+
+Everything is plain-python dict/deque work — no jax imports, no host
+syncs — so updating a metric costs nanoseconds and is safe from any hot
+path (including signal handlers). Two export shapes:
+``tracker_stats()`` is the flat float dict the existing tracker protocol
+carries per iteration; ``summary()`` is the structured run-level record
+``telemetry.json`` persists.
+"""
+
+from collections import deque
+from typing import Dict, Optional
+
+
+class TimingHist:
+    """Duration accumulator for one named phase (seconds)."""
+
+    __slots__ = ("window", "count", "total", "max", "first", "last")
+
+    def __init__(self, window: int = 512):
+        self.window = deque(maxlen=window)
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.first: Optional[float] = None
+        self.last = 0.0
+
+    def observe(self, seconds: float) -> None:
+        seconds = float(seconds)
+        if self.first is None:
+            self.first = seconds
+        else:
+            # steady-state window excludes the first (compile-laden) call
+            # so p50/p95 describe the cached-executable regime
+            self.window.append(seconds)
+        self.count += 1
+        self.total += seconds
+        self.last = seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> float:
+        if not self.window:
+            return self.first or 0.0
+        ordered = sorted(self.window)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+    def stats(self) -> Dict[str, float]:
+        out = {
+            "count": self.count,
+            "total_s": self.total,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "max_s": self.max,
+            "last_s": self.last,
+        }
+        if self.first is not None:
+            out["first_s"] = self.first
+            # cache-miss heuristic: the first call dominated by compile
+            # stands well clear of the steady state (needs >= 2 further
+            # samples for a meaningful p50)
+            if len(self.window) >= 2 and out["p50_s"] > 0:
+                out["first_over_p50"] = self.first / out["p50_s"]
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.hists: Dict[str, TimingHist] = {}
+
+    # -- updates -------------------------------------------------------- #
+
+    def inc(self, name: str, n: float = 1.0) -> float:
+        value = self.counters.get(name, 0.0) + n
+        self.counters[name] = value
+        return value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        hist = self.hists.get(name)
+        if hist is None:
+            hist = self.hists[name] = TimingHist()
+        hist.observe(seconds)
+
+    # -- exports -------------------------------------------------------- #
+
+    def tracker_stats(self) -> Dict[str, float]:
+        """One flat float dict: the per-iteration emission shape. Counters
+        and gauges report their current value; histograms report the LAST
+        duration (the per-iteration ``time/<phase>`` breakdown — run-level
+        quantiles belong to summary(), not the metrics stream)."""
+        out = dict(self.counters)
+        out.update(self.gauges)
+        for name, hist in self.hists.items():
+            out[name] = hist.last
+        return out
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "timings": {n: h.stats() for n, h in self.hists.items()},
+        }

@@ -1,0 +1,102 @@
+"""Lightweight span tracer: named host-side phases as Chrome-trace events.
+
+``jax.profiler.trace`` ($TRLX_TPU_PROFILE_DIR, trlx_tpu.utils.profiling)
+captures a full device trace — heavyweight, TensorBoard-loadable, and
+usually off. This tracer is the always-cheap complement: every
+``span(name)`` records one complete event (``ph: "X"`` with microsecond
+``ts``/``dur``) into a bounded in-memory buffer, exported as one JSON
+object per line (JSONL) that Perfetto (https://ui.perfetto.dev) opens
+directly; for chrome://tracing wrap the lines in ``[...]``. Span names
+follow the phase vocabulary the learn loops use: ``rollout``,
+``reward_fn``, ``ppo_update``, ``ilql_update``, ``eval``,
+``checkpoint_save``; the first occurrence of each name is flagged
+(``args.first_call``) because on jitted phases it contains the trace +
+XLA-compile cost.
+
+Durations are HOST wall-clock between span entry and exit. JAX dispatch
+is asynchronous, so a span around a dispatch measures trace/compile/
+enqueue time — device execution lands in whichever later span first
+blocks on the result (typically the metrics fetch). That asymmetry is
+exactly the signal that matters on tunneled/remote runtimes, where
+dispatch latency — not device time — dominates the loop.
+
+Every span also feeds the metrics registry: a ``time/<name>`` histogram
+observation, and a ``compile/<name>_first_s`` gauge on the first call.
+"""
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional
+
+from trlx_tpu.telemetry.registry import MetricsRegistry
+
+
+class SpanTracer:
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        max_events: int = 100_000,
+        clock=time.perf_counter,
+    ):
+        self.registry = registry
+        self.max_events = max_events
+        self.clock = clock
+        self.t0 = clock()
+        self.events = []
+        self.dropped = 0
+        self._seen = set()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        start = self.clock()
+        try:
+            yield
+        finally:
+            end = self.clock()
+            dur = end - start
+            first = name not in self._seen
+            self._seen.add(name)
+            if len(self.events) < self.max_events:
+                event = {
+                    "name": name,
+                    "ph": "X",
+                    "ts": round((start - self.t0) * 1e6, 3),
+                    "dur": round(dur * 1e6, 3),
+                    "pid": os.getpid(),
+                    "tid": 0,
+                }
+                if first:
+                    event["args"] = {"first_call": True}
+                self.events.append(event)
+            else:
+                self.dropped += 1
+            if self.registry is not None:
+                self.registry.observe(f"time/{name}", dur)
+                if first:
+                    self.registry.set_gauge(f"compile/{name}_first_s", dur)
+                if self.dropped == 1:
+                    self.registry.inc("telemetry/trace_events_dropped")
+
+    def write_jsonl(self, path: str) -> str:
+        """One Chrome-trace event per line. Perfetto loads the file as-is;
+        a dropped-events marker is appended when the buffer overflowed so
+        a truncated trace never reads as a complete one."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            for event in self.events:
+                f.write(json.dumps(event) + "\n")
+            if self.dropped:
+                f.write(json.dumps({
+                    "name": f"[{self.dropped} events dropped]",
+                    "ph": "X",
+                    "ts": round((self.clock() - self.t0) * 1e6, 3),
+                    "dur": 0,
+                    "pid": os.getpid(),
+                    "tid": 0,
+                }) + "\n")
+        os.replace(tmp, path)
+        return path

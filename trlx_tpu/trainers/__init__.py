@@ -69,6 +69,17 @@ class BaseRLTrainer:
         initialize_runtime()
         # mesh: explicit > config (TrainConfig.mesh) > None (single device)
         self.mesh = mesh if mesh is not None else mesh_from_config(config.train)
+        # telemetry session (train.telemetry, default on): started at
+        # construction — BEFORE maybe_resume/make_experience — so restore
+        # counters and pre-learn rollout spans land in the run's registry.
+        # A fresh trainer = a fresh session (process-local, last one wins).
+        from trlx_tpu import telemetry
+
+        self._telemetry = telemetry.start_from_config(config)
+        # per-token flops / tokens-per-sample for throughput + MFU
+        # emission; subclasses overwrite with their analytic values
+        self._flops_per_token = 0
+        self._tokens_per_sample = 0
 
     # -- SPMD helpers (shared by all trainers) --------------------------- #
 
@@ -458,6 +469,53 @@ class BaseRLTrainer:
             step=self.iter_count,
             detail=detail,
         )
+
+    def _telemetry_stats(self, samples_per_sec: float) -> Dict:
+        """The per-iteration observability payload the learn loops merge
+        into their stats emission: ``time/*`` last phase durations,
+        ``fault/*`` counters, ``device/*`` HBM gauges, ``compile/*``
+        first-call latencies, plus ``throughput/*`` computed here from
+        the loop's sample clock and the trainer's analytic flops. Empty
+        when telemetry is disabled (the reference-parity stream)."""
+        from trlx_tpu import telemetry
+
+        tel = telemetry.current()
+        if tel is None:
+            return {}
+        out = tel.tracker_stats()
+        out["throughput/samples_per_sec"] = samples_per_sec
+        if self._tokens_per_sample:
+            tokens_per_sec = samples_per_sec * self._tokens_per_sample
+            out["throughput/tokens_per_sec"] = tokens_per_sec
+            mfu = telemetry.mfu_estimate(
+                tokens_per_sec, self._flops_per_token
+            )
+            if mfu is not None:
+                out["throughput/mfu"] = mfu
+        return out
+
+    def _finish_telemetry(self, kind: str, clock=None) -> None:
+        """learn()-exit hook: stamp the run's headline throughput and
+        persist/print the telemetry summary (trlx_tpu.telemetry — writes
+        ``run_dir/telemetry.json`` + ``trace.jsonl``). Runs on every exit
+        path including exceptions, so a diverged/preempted run still
+        leaves its observability record behind."""
+        from trlx_tpu import telemetry
+
+        tel = telemetry.current()
+        if tel is None:
+            return
+        if clock is not None and clock.total_samples:
+            sps = clock.samples_per_second()
+            tel.set_headline(
+                f"{kind}_learn_samples_per_sec", sps, "samples/s"
+            )
+            if self._tokens_per_sample:
+                tel.registry.set_gauge(
+                    "throughput/tokens_per_sec",
+                    sps * self._tokens_per_sample,
+                )
+        tel.finish()
 
     def _preempt(self, log_fn, guard, just_saved: bool = False) -> bool:
         """Checkpoint + True when a SIGTERM arrived on ANY process

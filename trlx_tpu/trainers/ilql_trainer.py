@@ -126,6 +126,16 @@ class JaxILQLTrainer(BaseRLTrainer):
         self.reward_fn: Optional[Callable] = None
         self.stats_fn: Optional[Callable] = None
 
+        # analytic flops for throughput/mfu emission; tokens-per-sample is
+        # set in _learn_loop from the collated dataset's real width
+        from trlx_tpu.telemetry import ilql_train_flops_per_token
+
+        self._flops_per_token = ilql_train_flops_per_token(
+            spec,
+            resolve_num_unfrozen(spec, config.model.num_layers_unfrozen),
+            m.two_qs,
+        )
+
         self._build_jitted_fns()
         # resume at construction (see JaxPPOTrainer: restored state must be
         # live before any evaluation/sampling the caller does pre-learn)
@@ -382,44 +392,54 @@ class JaxILQLTrainer(BaseRLTrainer):
         (explicit opt-in for final/offline evaluation)."""
         if self.eval_pipeline is None or len(self.eval_pipeline) == 0:
             return {}
+        from trlx_tpu import telemetry
+
         prompts = self.eval_pipeline.texts
         if n is None:
             n = self.EVAL_CAP
         if n:
             prompts = prompts[:n]
-        samples = self.sample(prompts)
-        sample_lists = [list(map(int, row)) for row in samples]
-        logs = {}
-        decoded = None
-        if len(prompts) and isinstance(prompts[0], str):
-            decoded = self.tokenizer.batch_decode(samples)
-        if self.reward_fn is not None:
-            from trlx_tpu.utils.faults import retry_call
+        with telemetry.span("eval"):
+            samples = self.sample(prompts)
+            sample_lists = [list(map(int, row)) for row in samples]
+            logs = {}
+            decoded = None
+            if len(prompts) and isinstance(prompts[0], str):
+                decoded = self.tokenizer.batch_decode(samples)
+            if self.reward_fn is not None:
+                from trlx_tpu.utils.faults import retry_call
 
-            rewards = np.asarray(
-                retry_call(
-                    self.reward_fn,
-                    decoded if decoded is not None else sample_lists,
-                    retries=getattr(self.config.train, "host_retries", 2),
-                    backoff=getattr(
-                        self.config.train, "host_retry_backoff", 0.5
-                    ),
-                    label="reward_fn (eval)",
-                ),
-                np.float32,
-            )
-            logs["reward"] = float(rewards.mean())
-            if decoded is not None:
-                # first-128 samples table (reference:
-                # accelerate_ilql_model.py:128-157)
-                logs["samples_table"] = samples_table(decoded, rewards)
-        if self.stats_fn is not None:
-            logs.update(self.stats_fn(sample_lists))
+                with telemetry.span("reward_fn"):
+                    rewards = np.asarray(
+                        retry_call(
+                            self.reward_fn,
+                            decoded if decoded is not None else sample_lists,
+                            retries=getattr(
+                                self.config.train, "host_retries", 2
+                            ),
+                            backoff=getattr(
+                                self.config.train, "host_retry_backoff", 0.5
+                            ),
+                            label="reward_fn (eval)",
+                        ),
+                        np.float32,
+                    )
+                logs["reward"] = float(rewards.mean())
+                if decoded is not None:
+                    # first-128 samples table (reference:
+                    # accelerate_ilql_model.py:128-157)
+                    logs["samples_table"] = samples_table(decoded, rewards)
+            if self.stats_fn is not None:
+                logs.update(self.stats_fn(sample_lists))
         return logs
 
     def learn(self, log_fn: Callable = None, save_fn=None, eval_fn=None):
         """Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace
-        of the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
+        of the loop (trlx_tpu.utils.profiling). With train.telemetry
+        (default on) every log emission carries the time/* / throughput/*
+        / fault/* / device/* breakdown and a telemetry.json + trace.jsonl
+        land in the run dir at exit (trlx_tpu.telemetry, docs
+        "Observability"). SIGTERM during the loop
         checkpoints at the next step boundary and returns cleanly
         (train.save_on_preemption, trlx_tpu.utils.preemption). With
         train.max_bad_steps > 0, non-finite updates are skipped on device
@@ -446,6 +466,16 @@ class JaxILQLTrainer(BaseRLTrainer):
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         step_guard = self._make_step_guard(log_fn)
         clock = Clock()
+        try:
+            self._learn_epochs(log_fn, guard, step_guard, clock, cfg, m)
+        finally:
+            # every exit path (completion, preemption, DivergenceError)
+            # leaves the run's telemetry.json + trace.jsonl behind
+            self._finish_telemetry("ilql", clock)
+
+    def _learn_epochs(self, log_fn, guard, step_guard, clock, cfg, m):
+        from trlx_tpu.utils.profiling import annotate
+
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
 
         # the loader's pad id must be a valid model token (masked out in the
@@ -470,6 +500,9 @@ class JaxILQLTrainer(BaseRLTrainer):
         full = next(iter(self.train_store.create_loader(
             n, shuffle=False, eos_token_id=pad_id, pad_to_multiple=sp,
         )))
+        # the collated store-global width IS the per-sample token count
+        # every step processes (throughput/tokens_per_sec, MFU)
+        self._tokens_per_sample = int(full.input_ids.shape[1])
         from trlx_tpu.utils import tree_bytes
 
         device_resident = tree_bytes(full) <= int(os.environ.get(
@@ -491,20 +524,21 @@ class JaxILQLTrainer(BaseRLTrainer):
                     if ev:
                         log_fn({"iter": self.iter_count, **ev})
 
-                if device_resident:
-                    self.params, self.opt_state, stats = (
-                        self._train_step_indexed(
-                            self.params, self.opt_state, dataset,
-                            jnp.asarray(idx, jnp.int32),
+                with annotate("ilql_update"):
+                    if device_resident:
+                        self.params, self.opt_state, stats = (
+                            self._train_step_indexed(
+                                self.params, self.opt_state, dataset,
+                                jnp.asarray(idx, jnp.int32),
+                            )
                         )
-                    )
-                else:
-                    batch = jax.tree_util.tree_map(
-                        lambda x: x[idx], full
-                    )
-                    self.params, self.opt_state, stats = self._train_step(
-                        self.params, self.opt_state, self._put(batch)
-                    )
+                    else:
+                        batch = jax.tree_util.tree_map(
+                            lambda x: x[idx], full
+                        )
+                        self.params, self.opt_state, stats = self._train_step(
+                            self.params, self.opt_state, self._put(batch)
+                        )
                 self.iter_count += 1
                 clock.tick(len(idx))
                 # divergence verdict (free when disabled); a rollback
@@ -519,11 +553,15 @@ class JaxILQLTrainer(BaseRLTrainer):
                         k: float(v)
                         for k, v in jax.device_get(stats).items()
                     }
+                    sps = clock.samples_per_second()
                     host.update(
                         iter=self.iter_count,
                         epoch=epoch,
-                        samples_per_sec=clock.samples_per_second(),
+                        samples_per_sec=sps,
                     )
+                    # time/* / throughput/* / fault/* / device/* payload
+                    # ({} when train.telemetry is off)
+                    host.update(self._telemetry_stats(sps))
                     log_fn(host)
                 saved_now = (
                     self.iter_count % cfg.checkpoint_interval == 0

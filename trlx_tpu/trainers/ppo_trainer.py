@@ -193,6 +193,17 @@ class JaxPPOTrainer(BaseRLTrainer):
         self.orch = None
         self.reward_fn: Optional[Callable] = None
         self.logit_mask = None  # optional [V] bool; see set_logit_mask
+        # analytic throughput accounting (trlx_tpu.telemetry.flops): one
+        # optimization step touches input+gen tokens; MFU divides the
+        # resulting flops rate by the chip's bf16 peak when known
+        from trlx_tpu.telemetry import ppo_train_flops_per_token
+
+        self._tokens_per_sample = (
+            config.train.input_size + config.train.gen_size
+        )
+        self._flops_per_token = ppo_train_flops_per_token(
+            spec, config.model.num_layers_unfrozen
+        )
         self._build_jitted_fns()
         # resume at CONSTRUCTION, not first learn(): the documented flow
         # runs make_experience() before learn(), and rollouts generated by
@@ -557,20 +568,27 @@ class JaxPPOTrainer(BaseRLTrainer):
                 eval_prompts = next(iter(loader))
             except StopIteration:
                 return {}
-        query, mask = eval_prompts
-        out = self.generate(query, mask)
-        sequences, gen_tokens = jax.device_get(
-            (out.sequences, out.gen_tokens)
-        )
-        texts = self.tokenizer.batch_decode(sequences, skip_special_tokens=True)
+        from trlx_tpu import telemetry
         from trlx_tpu.utils.faults import retry_call
 
-        scores = np.asarray(retry_call(
-            self.reward_fn, texts,
-            retries=getattr(self.config.train, "host_retries", 2),
-            backoff=getattr(self.config.train, "host_retry_backoff", 0.5),
-            label="reward_fn (eval)",
-        ), np.float32)
+        query, mask = eval_prompts
+        with telemetry.span("eval"):
+            out = self.generate(query, mask)
+            sequences, gen_tokens = jax.device_get(
+                (out.sequences, out.gen_tokens)
+            )
+            texts = self.tokenizer.batch_decode(
+                sequences, skip_special_tokens=True
+            )
+            with telemetry.span("reward_fn"):
+                scores = np.asarray(retry_call(
+                    self.reward_fn, texts,
+                    retries=getattr(self.config.train, "host_retries", 2),
+                    backoff=getattr(
+                        self.config.train, "host_retry_backoff", 0.5
+                    ),
+                    label="reward_fn (eval)",
+                ), np.float32)
         query_texts = self.tokenizer.batch_decode(
             np.asarray(query), skip_special_tokens=True
         )
@@ -603,7 +621,12 @@ class JaxPPOTrainer(BaseRLTrainer):
         tests/test_ppo_e2e.py::test_termination_either_bound.
 
         Set $TRLX_TPU_PROFILE_DIR to capture a jax.profiler device trace of
-        the loop (trlx_tpu.utils.profiling). SIGTERM during the loop
+        the loop (trlx_tpu.utils.profiling). With train.telemetry (default
+        on) every log emission carries the time/* phase breakdown,
+        throughput/* (tokens/sec, samples/sec, MFU), fault/* counters and
+        device/* HBM gauges, and a telemetry.json summary + Chrome-trace
+        trace.jsonl land in the run dir at exit (trlx_tpu.telemetry, docs
+        "Observability"). SIGTERM during the loop
         checkpoints at the next step boundary and returns cleanly
         (train.save_on_preemption, trlx_tpu.utils.preemption). With
         train.max_bad_steps > 0, non-finite / KL-breaching updates are
@@ -624,13 +647,18 @@ class JaxPPOTrainer(BaseRLTrainer):
         # stays bounded relative to eviction grace windows (a spot node
         # gives ~30s); train.preempt_poll_interval overrides for regimes
         # where 8 steps outlast the grace period.
-        with maybe_trace(), PreemptionGuard(
-            cfg.save_on_preemption,
-            poll_interval=(cfg.preempt_poll_interval
-                           or min(cfg.log_interval, 8)),
-        ) as guard:
-            self._learn_loop(log_fn, cfg, m, clock, annotate, guard,
-                             step_guard)
+        try:
+            with maybe_trace(), PreemptionGuard(
+                cfg.save_on_preemption,
+                poll_interval=(cfg.preempt_poll_interval
+                               or min(cfg.log_interval, 8)),
+            ) as guard:
+                self._learn_loop(log_fn, cfg, m, clock, annotate, guard,
+                                 step_guard)
+        finally:
+            # every exit path (completion, preemption, DivergenceError)
+            # leaves the run's telemetry.json + trace.jsonl behind
+            self._finish_telemetry("ppo", clock)
 
     def _batch_runner(self, cfg):
         """(iterator, run, rows): one optimization-batch step per item.
@@ -721,12 +749,18 @@ class JaxPPOTrainer(BaseRLTrainer):
                         k: float(v)
                         for k, v in jax.device_get(stats).items()
                     }
+                    sps = clock.samples_per_second()
                     host_stats.update(
                         iter=self.iter_count,
                         epoch=self.epoch,
                         kl_coef=self.kl_ctl.value,
-                        samples_per_sec=clock.samples_per_second(),
+                        samples_per_sec=sps,
                     )
+                    # observability payload: time/* phase breakdown,
+                    # throughput/* (tokens/sec + MFU), fault/* counters,
+                    # device/* HBM gauges (trlx_tpu.telemetry; {} when
+                    # train.telemetry is off)
+                    host_stats.update(self._telemetry_stats(sps))
                     log_fn(host_stats)
                 if intervals["do_eval"]:
                     ev = self.evaluate()
@@ -750,7 +784,8 @@ class JaxPPOTrainer(BaseRLTrainer):
                 self.store.clear_history()
                 with annotate("rollout_harvest"):
                     info = self.orch.finish_experience(pending_exp)
-                log_fn({"iter": self.iter_count, "epoch": self.epoch, **info})
+                log_fn({"iter": self.iter_count, "epoch": self.epoch, **info,
+                        **self._telemetry_stats(clock.samples_per_second())})
                 if self._preempt(log_fn, guard):
                     return
             elif self.orch is not None and self.iter_count < cfg.total_steps \
@@ -760,7 +795,11 @@ class JaxPPOTrainer(BaseRLTrainer):
                     info = self.orch.make_experience(
                         m.num_rollouts, self.iter_count
                     )
-                log_fn({"iter": self.iter_count, "epoch": self.epoch, **info})
+                # the refresh emission carries the observability payload
+                # too: short runs (or long log_intervals) still surface
+                # time/* / throughput/* / fault/* every epoch
+                log_fn({"iter": self.iter_count, "epoch": self.epoch, **info,
+                        **self._telemetry_stats(clock.samples_per_second())})
                 if self._preempt(log_fn, guard):
                     return
 

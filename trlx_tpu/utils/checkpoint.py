@@ -108,40 +108,44 @@ def save_components(components: Dict[str, Any], directory: str) -> None:
         return
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
-    parent = os.path.dirname(directory)
-    if parent:
-        os.makedirs(parent, exist_ok=True)
-    staging = f"{directory}.tmp-{os.getpid()}"
-    if os.path.isdir(staging):
-        shutil.rmtree(staging)  # leftover from a previous crashed save
-    os.makedirs(staging)
-    meta = {}
-    with ocp.PyTreeCheckpointer() as ckptr, ocp.PyTreeCheckpointer(
-        use_ocdbt=False
-    ) as plain_ckptr:
-        for name, obj in components.items():
-            if _is_array_tree(obj):
-                writer = plain_ckptr if _has_empty_leaf(obj) else ckptr
-                writer.save(os.path.join(staging, name), obj, force=True)
-            else:
-                meta[name] = obj
-    # the commit marker: written last, atomically, inside staging
-    _atomic_write_text(json.dumps(meta), os.path.join(staging, META_NAME))
+    from trlx_tpu import telemetry
 
-    if os.path.isdir(directory):
-        # rename-aside then promote: os.replace cannot replace a
-        # non-empty dir, and deleting the old checkpoint BEFORE the new
-        # one is committed would reopen the exact corruption window this
-        # module exists to close
-        aside = f"{directory}.old-{os.getpid()}"
-        if os.path.isdir(aside):
+    with telemetry.span("checkpoint_save"):
+        directory = os.path.abspath(directory)
+        parent = os.path.dirname(directory)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        staging = f"{directory}.tmp-{os.getpid()}"
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)  # leftover from a previous crashed save
+        os.makedirs(staging)
+        meta = {}
+        with ocp.PyTreeCheckpointer() as ckptr, ocp.PyTreeCheckpointer(
+            use_ocdbt=False
+        ) as plain_ckptr:
+            for name, obj in components.items():
+                if _is_array_tree(obj):
+                    writer = plain_ckptr if _has_empty_leaf(obj) else ckptr
+                    writer.save(os.path.join(staging, name), obj, force=True)
+                else:
+                    meta[name] = obj
+        # the commit marker: written last, atomically, inside staging
+        _atomic_write_text(json.dumps(meta), os.path.join(staging, META_NAME))
+
+        if os.path.isdir(directory):
+            # rename-aside then promote: os.replace cannot replace a
+            # non-empty dir, and deleting the old checkpoint BEFORE the new
+            # one is committed would reopen the exact corruption window this
+            # module exists to close
+            aside = f"{directory}.old-{os.getpid()}"
+            if os.path.isdir(aside):
+                shutil.rmtree(aside)
+            os.replace(directory, aside)
+            os.replace(staging, directory)
             shutil.rmtree(aside)
-        os.replace(directory, aside)
-        os.replace(staging, directory)
-        shutil.rmtree(aside)
-    else:
-        os.replace(staging, directory)
+        else:
+            os.replace(staging, directory)
+        telemetry.inc("checkpoint/saves")
 
 
 def step_dir(run_dir: str, step: int) -> str:
@@ -181,6 +185,8 @@ def gc_checkpoints(run_dir: str, keep: int) -> None:
     (``keep <= 0`` keeps everything), plus any dead staging/aside
     leftovers from crashed saves. Invalid step dirs are removed too —
     they are torn writes, not restorable state."""
+    from trlx_tpu import telemetry
+
     run_dir = os.path.abspath(run_dir)
     if not os.path.isdir(run_dir):
         return
@@ -189,12 +195,14 @@ def gc_checkpoints(run_dir: str, keep: int) -> None:
         path = os.path.join(run_dir, entry)
         if ".tmp-" in entry or ".old-" in entry:
             shutil.rmtree(path, ignore_errors=True)
+            telemetry.inc("fault/checkpoint_debris_cleared")
             continue
         m = _STEP_RE.match(entry)
         if not m:
             continue
         if not is_valid_checkpoint(path):
             shutil.rmtree(path, ignore_errors=True)
+            telemetry.inc("fault/checkpoint_debris_cleared")
             continue
         steps.append((int(m.group(1)), path))
     if keep and keep > 0:
@@ -291,4 +299,7 @@ def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, An
                 )
             else:
                 out[name] = meta[name]
+    from trlx_tpu import telemetry
+
+    telemetry.inc("checkpoint/restores")
     return out

@@ -18,6 +18,12 @@ is exactly wrong for:
   OUTSIDE the jitted world and fail transiently. :func:`retry_call` gives
   them bounded retry-with-backoff; trackers additionally degrade to stdout
   (trlx_tpu.utils.trackers.ResilientTracker) rather than killing the run.
+
+Every containment event also increments a ``fault/*`` telemetry counter
+(``fault/skipped_steps``, ``fault/rollbacks``, ``fault/divergence_aborts``,
+``fault/host_retries``, ``fault/host_giveups`` — trlx_tpu.telemetry), so a
+sick run is visible in the metrics stream, not only in stdout archaeology
+(docs "Observability").
 """
 
 import time
@@ -44,6 +50,8 @@ def retry_call(
     and the LAST exception re-raised when the budget is exhausted — a
     persistently-broken seam must still fail loudly, just not on its first
     hiccup. ``retries=0`` is a plain call."""
+    from trlx_tpu import telemetry
+
     attempt = 0
     while True:
         try:
@@ -51,7 +59,9 @@ def retry_call(
         except Exception as e:
             attempt += 1
             if attempt > retries:
+                telemetry.inc("fault/host_giveups")
                 raise
+            telemetry.inc("fault/host_retries")
             delay = backoff * (2 ** (attempt - 1))
             log(
                 f"[trlx_tpu] {label or getattr(fn, '__name__', 'call')} "
@@ -107,11 +117,14 @@ class StepGuard:
         """Record one step verdict; returns "ok", "skipped", or
         "rollback". Raises :class:`DivergenceError` on the second strike
         (or when rollback is needed but impossible)."""
+        from trlx_tpu import telemetry
+
         if not self.enabled or not bad:
             self.bad_streak = 0
             return "ok"
         self.bad_streak += 1
         self.total_bad += 1
+        telemetry.inc("fault/skipped_steps")
         self._history.append((int(step), dict(detail or {})))
         self.log(
             {
@@ -124,11 +137,14 @@ class StepGuard:
         if self.bad_streak < self.max_bad_steps:
             return "skipped"
         if self.rollbacks >= self.max_rollbacks:
+            telemetry.inc("fault/divergence_aborts")
             raise DivergenceError(self._diagnostic(step, detail, strike=True))
         restored = self.rollback_fn() if self.rollback_fn else None
         if restored is None:
+            telemetry.inc("fault/divergence_aborts")
             raise DivergenceError(self._diagnostic(step, detail, strike=False))
         self.rollbacks += 1
+        telemetry.inc("fault/rollbacks")
         self.bad_streak = 0
         self.log(
             {"iter": step, "rollback": 1.0, "restored_from": str(restored)}

@@ -42,6 +42,11 @@ class PreemptionGuard:
 
     def _on_signal(self, signum, frame):
         self.requested = True
+        # plain dict increment — safe inside a signal handler, and makes
+        # the eviction visible in the metrics stream (fault/* counters)
+        from trlx_tpu import telemetry
+
+        telemetry.inc("fault/preempt_sigterm")
 
     def poll(self) -> bool:
         """The preemption flag AGREED across JAX processes: any rank's

@@ -11,8 +11,14 @@ are added on top:
 - `annotate(name)` marks host-side phases (rollout, reward_fn, update) so
   they are attributable inside the trace timeline.
 
-Zero overhead when disabled: both helpers collapse to no-op context
-managers unless a trace directory is configured.
+``annotate`` ALSO opens a lightweight telemetry span of the same name
+(trlx_tpu.telemetry): when a telemetry session is active, every annotated
+phase lands in the ``time/*`` histograms and the Chrome-trace/Perfetto
+``trace.jsonl`` — the always-on complement to the heavyweight device
+trace (docs "Observability" explains when to reach for which).
+
+Zero overhead when disabled: with no profile dir AND no telemetry
+session, both helpers collapse to no-op context managers.
 """
 
 import contextlib
@@ -47,12 +53,37 @@ def maybe_trace(trace_dir: Optional[str] = None):
         _tracing_active = False
 
 
+class _Stacked:
+    """Enter/exit a fixed pair of context managers (telemetry span +
+    profiler annotation) without contextlib.ExitStack's allocation cost —
+    this sits on the per-step hot path."""
+
+    __slots__ = ("cms",)
+
+    def __init__(self, *cms):
+        self.cms = cms
+
+    def __enter__(self):
+        for cm in self.cms:
+            cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        suppressed = False
+        for cm in reversed(self.cms):
+            suppressed = bool(cm.__exit__(*exc)) or suppressed
+        return suppressed
+
+
 def annotate(name: str):
-    """Named host-span annotation visible in profiler traces; no-op unless
-    a maybe_trace() region is active (TraceAnnotation is cheap but not
-    free)."""
+    """Named host-span annotation: a telemetry span (no-op without an
+    active session) plus, while a maybe_trace() region is open, a
+    jax.profiler.TraceAnnotation visible in the device trace timeline."""
+    from trlx_tpu import telemetry
+
+    span = telemetry.span(name)
     if not _tracing_active:
-        return contextlib.nullcontext()
+        return span
     import jax
 
-    return jax.profiler.TraceAnnotation(name)
+    return _Stacked(span, jax.profiler.TraceAnnotation(name))

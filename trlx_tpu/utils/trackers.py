@@ -17,6 +17,7 @@ The step is read from the ``iter`` key when present.
 
 import importlib
 import json
+import os
 from typing import Any, Dict, List, Optional
 
 
@@ -74,6 +75,7 @@ class WandbTracker:
     def __init__(self, project_name: str, config_dict: Optional[Dict] = None,
                  **init_kwargs):
         self._wandb = importlib.import_module("wandb")
+        self._last_step: Optional[int] = None
         self.run = self._wandb.init(
             project=project_name or None, config=config_dict, **init_kwargs
         )
@@ -81,6 +83,15 @@ class WandbTracker:
     def __call__(self, stats: Dict[str, Any]) -> None:
         scalars, tables = _split(stats)
         step = scalars.get("iter")
+        # emissions without an `iter` (eval tables, rollout-refresh info
+        # logged between train iterations) reuse the last seen step:
+        # wandb's step=None silently re-monotonizes and misaligns those
+        # rows against the train series they belong with
+        if step is None:
+            step = self._last_step
+        else:
+            step = int(step)
+            self._last_step = step
         payload = {
             k: v for k, v in scalars.items()
             if not isinstance(v, (list, tuple, dict))
@@ -90,17 +101,24 @@ class WandbTracker:
                 columns=list(tbl.get("columns", [])),
                 rows=[list(r) for r in tbl["rows"]],
             )
-        self._wandb.log(payload, step=int(step) if step is not None else None)
+        self._wandb.log(payload, step=step)
 
     def finish(self) -> None:
         self.run.finish()
 
 
 class JsonlTracker:
-    """Append-only JSONL sink for offline runs / tests."""
+    """Append-only JSONL sink for offline runs / tests.
+
+    The parent directory is created lazily at the first emission — a
+    ``jsonl:runs/x/log.jsonl`` spec whose directory doesn't exist yet must
+    not fail every emission until ResilientTracker degrades it to stdout.
+    ``finish()`` fsyncs, so a run killed right after its final emission
+    doesn't lose the tail to the page cache."""
 
     def __init__(self, path: str):
         self.path = path
+        self._dir_ready = False
 
     def __call__(self, stats: Dict[str, Any]) -> None:
         def default(o):
@@ -109,11 +127,19 @@ class JsonlTracker:
             except (TypeError, ValueError):
                 return str(o)
 
+        if not self._dir_ready:
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+            self._dir_ready = True
         with open(self.path, "a") as f:
             f.write(json.dumps(stats, default=default) + "\n")
 
     def finish(self) -> None:
-        pass
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "a") as f:
+            f.flush()
+            os.fsync(f.fileno())
 
 
 class ResilientTracker:
@@ -136,8 +162,10 @@ class ResilientTracker:
         self.fallback_factory = fallback_factory
         self.failures = 0
         self.degraded = False
+        self._failed_inner = None  # the original sink, kept for finish()
 
     def __call__(self, stats: Dict[str, Any]) -> None:
+        from trlx_tpu import telemetry
         from trlx_tpu.utils.faults import retry_call
 
         if self.degraded:
@@ -149,6 +177,7 @@ class ResilientTracker:
             self.failures = 0
         except Exception as e:
             self.failures += 1
+            telemetry.inc("fault/tracker_emissions_lost")
             print(f"[trlx_tpu] tracker emission lost after retries "
                   f"({type(e).__name__}: {e}); "
                   f"{self.failures}/{self.max_consecutive_failures} "
@@ -156,16 +185,24 @@ class ResilientTracker:
             if self.failures >= self.max_consecutive_failures:
                 print("[trlx_tpu] tracker persistently failing; degrading "
                       "to stdout for the rest of the run", flush=True)
+                telemetry.inc("fault/tracker_degraded")
                 self.degraded = True
+                self._failed_inner = self.inner
                 self.inner = self.fallback_factory()
                 self.inner(stats)
 
     def finish(self) -> None:
-        try:
-            self.inner.finish()
-        except Exception as e:
-            print(f"[trlx_tpu] tracker finish failed ({e!r}); ignored",
-                  flush=True)
+        # on a degraded sink, ALSO try to finish the original failed
+        # inner: a wandb run left open keeps its upload threads alive and
+        # leaks the process on exit even though emissions moved to stdout
+        for sink in (self.inner, self._failed_inner):
+            if sink is None:
+                continue
+            try:
+                sink.finish()
+            except Exception as e:
+                print(f"[trlx_tpu] tracker finish failed ({e!r}); ignored",
+                      flush=True)
 
 
 class MultiTracker:
