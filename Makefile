@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults telemetry
+.PHONY: style check test faults telemetry chaos
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -32,3 +32,11 @@ faults:
 telemetry:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
 		tests/test_trackers.py -q
+
+# run-supervisor tier: the deterministic chaos-injection matrix
+# (hang/exc/slow/sigterm at named seams) driving watchdog stall
+# detection + stack dumps, bounded host-seam timeouts, walltime-deadline
+# exits, escalation, and the checkpoint-and-exit containment. Part of
+# the non-slow tier-1 set; this target runs just them.
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q

@@ -92,13 +92,16 @@ def test_lint(path):
 
     lib = REPO / "trlx_tpu"
     if lib in path.parents:
-        # all timing goes through Clock (utils/__init__.py) or the
-        # telemetry registry/tracer: ad-hoc time.time()/perf_counter()
-        # deltas are exactly the opaque instrumentation the unified
-        # telemetry layer replaced (docs "Observability")
+        # all timing goes through Clock (utils/__init__.py), the
+        # telemetry registry/tracer, or the run supervisor's watchdog
+        # clock (supervisor/ — its timing IS the supervision mechanism
+        # and surfaces as fault/* counters): ad-hoc time.time()/
+        # perf_counter() deltas are exactly the opaque instrumentation
+        # the unified telemetry layer replaced (docs "Observability")
         timing_allowed = (
             path == lib / "utils" / "__init__.py"
             or (lib / "telemetry") in path.parents
+            or (lib / "supervisor") in path.parents
         )
         if not timing_allowed:
             for node in ast.walk(tree):

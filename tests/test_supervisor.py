@@ -1,0 +1,598 @@
+"""Run supervisor: heartbeat watchdog, host-seam timeouts, walltime
+deadlines, and the deterministic chaos-injection harness — every "stuck
+!= dead" containment path exercised by actually injecting its stall, all
+CPU-runnable tier-1 (``make chaos``).
+
+Acceptance scenarios (ISSUE 3):
+
+- a chaos-injected HUNG reward_fn is detected by the watchdog within
+  ``train.stall_timeout`` (stack dump + ``fault/stalls``), timed out by
+  the bounded seam, retried, and the run COMPLETES — with telemetry on
+  and off;
+- a chaos-injected PERMANENT stall ends in a clean checkpoint-and-exit
+  (resumable checkpoint committed, ``StallError`` raised, all-thread
+  stack dump in the log);
+- ``train.max_walltime`` saves a resumable checkpoint and exits cleanly.
+"""
+
+import contextlib
+import io
+import threading
+import time
+
+import pytest
+
+from trlx_tpu import supervisor, telemetry
+from trlx_tpu.supervisor import (
+    RunSupervisor,
+    SeamTimeout,
+    StallError,
+    bounded_call,
+    chaos,
+    seam_timeout,
+)
+from trlx_tpu.utils.faults import retry_call
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """No leaked telemetry session or chaos schedule across tests (and
+    release any injected hangs abandoned in worker threads)."""
+    telemetry.stop()
+    chaos.reset()
+    yield
+    telemetry.stop()
+    chaos.reset()
+
+
+# --------------------------------------------------------------------- #
+# bounded host seams
+# --------------------------------------------------------------------- #
+
+
+def test_bounded_call_passthrough_and_exceptions():
+    assert bounded_call(lambda: 42, timeout=1.0) == 42
+    assert bounded_call(lambda: 42, timeout=0.0) == 42  # 0 = unbounded
+
+    def boom():
+        raise ValueError("from worker")
+
+    with pytest.raises(ValueError, match="from worker"):
+        bounded_call(boom, timeout=1.0)
+
+
+def test_bounded_call_times_out_hung_call_and_counts():
+    tel = telemetry.start()
+    with pytest.raises(SeamTimeout) as exc:
+        bounded_call(lambda: time.sleep(10), timeout=0.1, label="reward_fn")
+    # actionable: names the seam, the knob, and the failure class
+    msg = str(exc.value)
+    assert "reward_fn" in msg and "hung" in msg
+    assert tel.registry.counters["fault/seam_timeouts"] == 1
+    # SeamTimeout IS-A StallError: learn loops contain it uniformly
+    assert isinstance(exc.value, StallError)
+    assert isinstance(exc.value, TimeoutError)
+
+
+def test_retry_call_timeout_retries_hung_then_succeeds():
+    """A seam that hangs once then answers must complete within the retry
+    budget — the containment the hung-reward_fn acceptance rests on."""
+    tel = telemetry.start()
+    hang_first = {"n": 1}
+
+    def sometimes_hung():
+        if hang_first["n"] > 0:
+            hang_first["n"] -= 1
+            time.sleep(10)
+        return "scored"
+
+    t0 = time.monotonic()
+    out = retry_call(sometimes_hung, retries=2, backoff=0.0, timeout=0.15)
+    assert out == "scored"
+    assert time.monotonic() - t0 < 5  # timed out, not sat out
+    assert tel.registry.counters["fault/seam_timeouts"] == 1
+    assert tel.registry.counters["fault/host_retries"] == 1
+
+    # permanently hung: budget exhausted -> SeamTimeout propagates
+    with pytest.raises(SeamTimeout):
+        retry_call(lambda: time.sleep(10), retries=1, backoff=0.0,
+                   timeout=0.1)
+
+
+def test_seam_timeout_knob_resolution():
+    import types
+
+    t = types.SimpleNamespace(host_call_timeout=0.0, stall_timeout=0.0)
+    assert seam_timeout(t) == 0.0  # both unset: unbounded (parity)
+    t.stall_timeout = 30.0
+    assert seam_timeout(t) == 30.0  # falls back to the watchdog budget
+    t.host_call_timeout = 5.0
+    assert seam_timeout(t) == 5.0  # explicit wins
+
+
+# --------------------------------------------------------------------- #
+# chaos schedule
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_schedule_parsing_and_occurrence_matching():
+    rules = chaos.parse_schedule(
+        "reward_fn:hang=30@3;ppo_update:exc@1,2;rollout:slow=0.5@2-4;"
+        "eval:sigterm"
+    )
+    assert [r.action for r in rules] == ["hang", "exc", "slow", "sigterm"]
+    assert rules[0].param == 30.0 and rules[0].matches(3)
+    assert not rules[0].matches(2)
+    assert rules[1].matches(1) and rules[1].matches(2) and not rules[1].matches(3)
+    assert rules[2].matches(2) and rules[2].matches(4) and not rules[2].matches(5)
+    assert rules[3].spans is None  # default '*': every occurrence
+
+    with pytest.raises(ValueError, match="does not parse"):
+        chaos.parse_schedule("reward_fn")
+    with pytest.raises(ValueError, match="unknown action"):
+        chaos.parse_schedule("reward_fn:explode@1")
+
+
+def test_chaos_exc_consumes_retries_deterministically():
+    """Injection fires per ATTEMPT inside retry_call, so 'exc@1,2' is a
+    fail-twice-succeed-third drill of the real retry path."""
+    chaos.configure("reward_fn:exc@1,2")
+    calls = {"n": 0}
+
+    def scorer():
+        calls["n"] += 1
+        return "ok"
+
+    assert retry_call(scorer, retries=2, backoff=0.0,
+                      seam="reward_fn") == "ok"
+    assert calls["n"] == 1  # first two attempts died BEFORE the fn ran
+
+    # deterministic: the same schedule re-armed injects identically
+    chaos.configure("reward_fn:exc@1,2")
+    with pytest.raises(chaos.ChaosError):
+        retry_call(scorer, retries=1, backoff=0.0, seam="reward_fn")
+
+
+def test_chaos_slow_and_unmatched_seams_are_inert():
+    chaos.configure("rollout:slow=0.1@1")
+    t0 = time.monotonic()
+    chaos.maybe_inject("rollout")
+    assert time.monotonic() - t0 >= 0.1
+    # other seams and later occurrences: untouched
+    t0 = time.monotonic()
+    chaos.maybe_inject("rollout")
+    chaos.maybe_inject("ppo_update")
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_chaos_env_var_overrides_config(monkeypatch):
+    import types
+
+    monkeypatch.setenv(chaos.ENV_VAR, "eval:exc@1")
+    sched = chaos.configure_from(types.SimpleNamespace(chaos="eval:slow@1"))
+    assert sched.rules[0].action == "exc"  # env wins
+    monkeypatch.delenv(chaos.ENV_VAR)
+    sched = chaos.configure_from(types.SimpleNamespace(chaos="eval:slow@1"))
+    assert sched.rules[0].action == "slow"
+    # neither set: an explicitly-installed schedule is left untouched
+    installed = chaos.configure("rollout:exc@1")
+    assert chaos.configure_from(types.SimpleNamespace(chaos="")) is installed
+
+
+def test_chaos_reset_releases_inflight_hangs():
+    chaos.configure("reward_fn:hang@*")
+    outcome = {}
+
+    def worker():
+        try:
+            chaos.maybe_inject("reward_fn")
+        except chaos.ChaosHang:
+            outcome["released"] = True
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    chaos.reset()
+    t.join(timeout=2)
+    assert outcome.get("released") is True
+
+
+# --------------------------------------------------------------------- #
+# heartbeat watchdog (unit)
+# --------------------------------------------------------------------- #
+
+
+def _stalled_run(sup, phase_name="ppo_update", hold=0.4):
+    """Enter sup, open one phase, and wedge the owner thread in it."""
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        with sup:
+            with supervisor.phase(phase_name):
+                time.sleep(hold)
+    return err.getvalue()
+
+
+def test_watchdog_detects_stall_dumps_stacks_and_counts():
+    tel = telemetry.start()
+    sup = RunSupervisor(stall_timeout=0.08, stall_first_timeout=0.08,
+                        stall_grace=100.0)
+    out = _stalled_run(sup)
+    assert sup.stalls == 1  # one dump per stalled phase occurrence
+    assert sup.stalled_phase == "ppo_update"
+    assert tel.registry.counters["fault/stalls"] == 1.0
+    # the dump is actionable: names the phase, the breached budget knob
+    # (the first occurrence of a phase is budgeted by
+    # train.stall_first_timeout), and every thread
+    assert "STALL" in out and "ppo_update" in out
+    assert "train.stall_first_timeout" in out
+    assert "MainThread" in out and "trlx-watchdog" in out
+
+
+def test_watchdog_first_call_compile_allowance():
+    """The first occurrence of a phase carries trace+compile cost and
+    gets the separate stall_first_timeout budget (telemetry's first-call
+    separation); the SECOND occurrence is held to stall_timeout."""
+    telemetry.start()
+    sup = RunSupervisor(stall_timeout=0.08, stall_first_timeout=10.0,
+                        stall_grace=100.0)
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        with sup:
+            with supervisor.phase("ppo_update"):
+                time.sleep(0.3)  # over stall_timeout, under first budget
+            assert sup.stalls == 0
+            with supervisor.phase("ppo_update"):
+                time.sleep(0.3)  # steady state: this IS a stall
+    assert sup.stalls == 1
+
+
+def test_watchdog_beat_defers_stall_and_other_threads_ignored():
+    telemetry.start()
+    sup = RunSupervisor(stall_timeout=0.3, stall_first_timeout=0.3,
+                        stall_grace=100.0)
+    with sup:
+        with supervisor.phase("rollout"):
+            for _ in range(5):  # 0.5s total, but beating every 0.1s
+                time.sleep(0.1)
+                supervisor.beat()
+        assert sup.stalls == 0
+
+        # a phase opened from a non-owner thread never reaches the stack
+        def other():
+            with supervisor.phase("rollout"):
+                pass
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert sup._phases == []
+
+
+def test_watchdog_escalates_checkpoint_exit_with_rescue():
+    tel = telemetry.start()
+    exits, rescued = [], []
+    sup = RunSupervisor(stall_timeout=0.05, stall_first_timeout=0.05,
+                        stall_grace=0.05, rescue_fn=lambda: rescued.append(1),
+                        exit_fn=exits.append)
+    out = _stalled_run(sup, hold=0.6)
+    assert sup.escalated
+    assert rescued == [1]
+    assert exits == [75]  # EX_TEMPFAIL: restart + resume_from auto
+    assert tel.registry.counters["fault/stall_escalations"] == 1.0
+    assert "ESCALATION" in out and "rescue checkpoint committed" in out
+
+
+def test_watchdog_escalates_abort_without_rescue():
+    telemetry.start()
+    exits, rescued = [], []
+    sup = RunSupervisor(stall_timeout=0.05, stall_first_timeout=0.05,
+                        stall_grace=0.05, stall_action="abort",
+                        rescue_fn=lambda: rescued.append(1),
+                        exit_fn=exits.append)
+    _stalled_run(sup, hold=0.6)
+    assert exits == [70] and rescued == []
+
+    with pytest.raises(ValueError, match="stall_action"):
+        RunSupervisor(stall_action="exit_quietly")
+
+
+def test_supervisor_inert_when_disabled():
+    sup = RunSupervisor()  # every knob 0
+    with sup:
+        assert supervisor.current() is sup
+        assert sup.phase("ppo_update") is supervisor.NULL_CM
+        assert not sup.stop_requested()
+        assert sup._thread is None  # no watchdog thread armed
+    assert supervisor.current() is None
+    # module-level hooks are no-ops without an active supervisor
+    assert supervisor.phase("x") is supervisor.NULL_CM
+    supervisor.beat()
+
+
+# --------------------------------------------------------------------- #
+# walltime deadline (unit) + rank agreement seam
+# --------------------------------------------------------------------- #
+
+
+def test_walltime_deadline_requests_stop_and_counts():
+    tel = telemetry.start()
+    sup = RunSupervisor(max_walltime=0.05)
+    with sup:
+        assert not sup.stop_requested()
+        time.sleep(0.08)
+        assert sup.deadline_reached()
+        assert sup.stop_requested()
+        assert sup.stop_reason() == "walltime_exceeded"
+    assert tel.registry.counters["fault/walltime_exits"] == 1.0
+
+
+def test_preemption_guard_poll_folds_supervisor_stop():
+    """The walltime/stall stop rides the SAME rank-agreement path as
+    SIGTERM (PreemptionGuard.poll extra=), so multi-host ranks exit
+    together."""
+    from trlx_tpu.utils.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(enabled=False)
+    assert guard.poll() is False
+    assert guard.poll(extra=False) is False
+    assert guard.poll(extra=True) is True
+
+
+# --------------------------------------------------------------------- #
+# satellites: pp zero-frozen-trunk guard, epoch batch-count helper,
+# aot recompile counter
+# --------------------------------------------------------------------- #
+
+
+def test_pp_rejects_zero_frozen_trunk_layers():
+    import types
+
+    from trlx_tpu.trainers import BaseRLTrainer
+
+    stub = types.SimpleNamespace(
+        mesh=types.SimpleNamespace(shape={"pp": 2, "sp": 1}),
+        config=types.SimpleNamespace(
+            train=types.SimpleNamespace(pp_num_microbatches=4)
+        ),
+    )
+    with pytest.raises(ValueError) as exc:
+        BaseRLTrainer._pp_kwargs(stub, 0, 8)
+    msg = str(exc.value)
+    assert "num_layers_unfrozen" in msg and "pp" in msg
+    # a non-empty trunk still resolves normally
+    out = BaseRLTrainer._pp_kwargs(stub, 4, 8)
+    assert out["pp_n_micro"] == 4
+
+
+def test_epoch_batch_count_matches_loader_drop_last():
+    """_will_refresh predicts the epoch length from the same helper the
+    batch runner's drop-last iteration actually yields."""
+    from trlx_tpu.pipeline import batch_iterator
+    from trlx_tpu.trainers.ppo_trainer import JaxPPOTrainer
+
+    for n, bs in ((37, 8), (64, 16), (15, 16), (48, 16)):
+        yielded = sum(
+            1 for _ in batch_iterator(n, bs, True, 0, lambda i: i,
+                                      drop_last=True)
+        )
+        assert JaxPPOTrainer._epoch_batch_count(n, bs) == yielded
+
+
+def test_aot_jit_counts_steady_state_recompiles():
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.aotjit import aot_jit
+
+    tel = telemetry.start()
+    fn = aot_jit(lambda x: x * 2)
+    fn(jnp.ones((4,)))  # warmup compile: not a recompile
+    fn(jnp.ones((4,)))  # cache hit
+    assert tel.registry.counters["compile/recompiles"] == 0.0
+    fn(jnp.ones((8,)))  # steady-state miss: signature drifted
+    assert tel.registry.counters["compile/recompiles"] == 1.0
+    fn(jnp.ones((8,)))  # the new signature is now cached
+    assert tel.registry.counters["compile/recompiles"] == 1.0
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: chaos-driven acceptance scenarios on the real PPO loop
+# --------------------------------------------------------------------- #
+
+
+def _supervised_ppo(tmp_path, telemetry_on=True, **train_over):
+    """Tiny supervised PPO stack (fresh per test: these tests mutate
+    params, checkpoints, and global chaos/telemetry state)."""
+    from tests.test_ppo_e2e import PROMPTS, make_config, reward_fn
+    from trlx_tpu.utils.loading import (
+        get_model,
+        get_orchestrator,
+        get_pipeline,
+    )
+    from trlx_tpu.utils.tokenizer import ByteTokenizer
+
+    config = make_config(total_steps=4, epochs=2, ppo_epochs=1,
+                         num_rollouts=32, chunk_size=16, batch_size=16)
+    config.train.checkpoint_dir = str(tmp_path / "ckpt")
+    config.train.telemetry = telemetry_on
+    config.train.telemetry_dir = str(tmp_path / "tel") if telemetry_on else ""
+    config.train.host_retries = 2
+    config.train.host_retry_backoff = 0.0
+    config.train.stall_timeout = 0.25
+    config.train.stall_first_timeout = 0.25
+    config.train.stall_grace = 600.0  # detection-only: never escalate here
+    config.train.host_call_timeout = 0.5
+    for k, v in train_over.items():
+        setattr(config.train, k, v)
+
+    trainer = get_model(config.model.model_type)(config)
+    trainer.tokenizer = ByteTokenizer()
+    pipeline = get_pipeline(config.train.pipeline)(
+        PROMPTS, trainer.tokenizer, config
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn,
+        chunk_size=config.method.chunk_size,
+    )
+    return config, trainer, orch
+
+
+@pytest.mark.parametrize("telemetry_on", [True, False],
+                         ids=["telemetry_on", "telemetry_off"])
+def test_hung_reward_fn_detected_timed_out_retried_run_completes(
+    tmp_path, capfd, telemetry_on
+):
+    """THE acceptance scenario: mid-learn, one reward_fn call hangs. The
+    watchdog detects the stall within train.stall_timeout and dumps
+    stacks; the bounded seam times the call out; retry_call retries it;
+    the run COMPLETES. With telemetry on, fault/stalls and
+    fault/seam_timeouts land in telemetry.json."""
+    import json
+    import os
+
+    config, trainer, orch = _supervised_ppo(
+        tmp_path, telemetry_on=telemetry_on
+    )
+    # experience BEFORE the schedule is installed (call counting starts
+    # at configure()), then hang the first in-learn reward attempt — the
+    # post-epoch refresh — so the watchdog (armed only during learn)
+    # sees it
+    orch.make_experience(config.method.num_rollouts)
+    chaos.configure("reward_fn:hang=30@1")
+
+    logs = []
+    trainer.learn(log_fn=logs.append)  # must complete: no exception
+
+    assert trainer.iter_count >= config.train.total_steps
+    err = capfd.readouterr().err
+    assert "STALL" in err and "reward_fn" in err  # detected + attributed
+    assert "MainThread" in err  # all-thread stack dump reached the log
+    if telemetry_on:
+        path = os.path.join(config.train.telemetry_dir, "telemetry.json")
+        with open(path) as f:
+            summary = json.load(f)
+        assert summary["counters"]["fault/stalls"] >= 1
+        assert summary["counters"]["fault/seam_timeouts"] >= 1
+        assert summary["counters"]["fault/host_retries"] >= 1
+    else:
+        assert telemetry.current() is None
+        assert not (tmp_path / "tel").exists()
+
+
+@pytest.mark.parametrize("telemetry_on", [True, False],
+                         ids=["telemetry_on", "telemetry_off"])
+def test_permanent_stall_checkpoint_and_exit(tmp_path, capfd, telemetry_on):
+    """A reward seam that hangs on EVERY attempt exhausts the retry
+    budget; the learn loop converts the stall into a clean
+    checkpoint-and-exit: resumable checkpoint committed, stack dump in
+    the log, StallError raised."""
+    import json
+    import os
+
+    from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+    config, trainer, orch = _supervised_ppo(
+        tmp_path, telemetry_on=telemetry_on, host_retries=1
+    )
+    orch.make_experience(config.method.num_rollouts)
+    chaos.configure("reward_fn:hang=30@*")  # every in-learn attempt
+
+    logs = []
+    with pytest.raises(StallError):
+        trainer.learn(log_fn=logs.append)
+
+    # clean exit: a resumable checkpoint at the stall point, the verdict
+    # in the metrics stream, the dump in the log
+    latest = find_latest_checkpoint(config.train.checkpoint_dir)
+    assert latest is not None
+    assert latest.endswith(f"step_{trainer.iter_count}")
+    assert any(s.get("stalled") for s in logs)
+    err = capfd.readouterr().err
+    assert "STALL" in err and "MainThread" in err
+    # and the checkpoint actually restores (resume_from: auto viability)
+    before = trainer.iter_count
+    trainer._resumed = False
+    config.train.resume_from = "auto"
+    assert trainer.maybe_resume() is True
+    assert trainer.iter_count == before
+    if telemetry_on:
+        path = os.path.join(config.train.telemetry_dir, "telemetry.json")
+        with open(path) as f:
+            summary = json.load(f)
+        assert summary["counters"]["fault/stalls"] >= 1
+
+
+def test_walltime_deadline_saves_resumable_checkpoint_and_exits(tmp_path):
+    """train.max_walltime: the loop save-and-exits cleanly at the first
+    step boundary past the deadline — no exception, committed checkpoint,
+    walltime verdict in the stream."""
+    from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+    config, trainer, orch = _supervised_ppo(
+        tmp_path, stall_timeout=0.0, max_walltime=0.001
+    )
+    orch.make_experience(config.method.num_rollouts)
+
+    logs = []
+    trainer.learn(log_fn=logs.append)  # returns cleanly
+
+    assert 0 < trainer.iter_count < config.train.total_steps
+    latest = find_latest_checkpoint(config.train.checkpoint_dir)
+    assert latest is not None and latest.endswith(
+        f"step_{trainer.iter_count}"
+    )
+    assert any(s.get("walltime_exceeded") for s in logs)
+
+
+def test_chaos_sigterm_drives_preemption_checkpoint(tmp_path):
+    """Injected SIGTERM at the update seam exercises PR 1's whole
+    preemption path: trap, step-boundary save, clean return."""
+    from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+    config, trainer, orch = _supervised_ppo(tmp_path, stall_timeout=0.0)
+    orch.make_experience(config.method.num_rollouts)
+    chaos.configure("ppo_update:sigterm@1")
+
+    logs = []
+    trainer.learn(log_fn=logs.append)  # clean preemption return
+
+    assert any(s.get("preempted") for s in logs)
+    latest = find_latest_checkpoint(config.train.checkpoint_dir)
+    assert latest is not None and latest.endswith(
+        f"step_{trainer.iter_count}"
+    )
+    assert trainer.iter_count < config.train.total_steps
+
+
+def test_chaos_exc_at_update_phase_propagates(tmp_path):
+    """An injected exception at a non-seam phase is NOT contained (it is
+    a bug surface, not a flaky seam): it must propagate — after leaving
+    telemetry behind."""
+    config, trainer, orch = _supervised_ppo(tmp_path, stall_timeout=0.0)
+    orch.make_experience(config.method.num_rollouts)
+    chaos.configure("ppo_update:exc@1")
+
+    with pytest.raises(chaos.ChaosError):
+        trainer.learn(log_fn=lambda s: None)
+
+
+def test_checkpoint_save_seam_bounded(tmp_path, monkeypatch):
+    """train.checkpoint_timeout: a save wedged on a dead filesystem
+    raises SeamTimeout instead of hanging the run."""
+    from tests.test_ppo_e2e import make_config
+    from trlx_tpu.utils.loading import get_model
+
+    config = make_config(total_steps=2, epochs=1)
+    config.train.checkpoint_dir = str(tmp_path / "ckpt")
+    config.train.checkpoint_timeout = 0.2
+    trainer = get_model(config.model.model_type)(config)
+
+    def wedged_save(components, run_dir, step=0, keep=0):
+        time.sleep(10)
+
+    # save() imports the symbol at call time, so patching the module
+    # attribute is enough
+    monkeypatch.setattr(
+        "trlx_tpu.utils.checkpoint.save_step_checkpoint", wedged_save
+    )
+    with pytest.raises(SeamTimeout, match="checkpoint_save"):
+        trainer.save()

@@ -185,6 +185,12 @@ class TrainConfig:
     :param telemetry / telemetry_dir: unified metrics/span telemetry
         (trlx_tpu.telemetry) — per-iteration time/* / throughput/* /
         fault/* keys and a telemetry.json + trace.jsonl at learn() exit
+    :param stall_timeout / stall_first_timeout / stall_grace /
+        stall_action / host_call_timeout / checkpoint_timeout /
+        max_walltime / chaos: run-supervisor knobs (trlx_tpu.supervisor)
+        — heartbeat watchdog with stack-dump + escalation, bounded host
+        seams that time out HUNG calls, walltime save-and-exit, and
+        deterministic chaos drills
     """
 
     n_ctx: int
@@ -282,6 +288,44 @@ class TrainConfig:
     # eviction grace windows). Lower it (e.g. 1) when single steps are
     # slow enough that 8 of them outlast your scheduler's SIGTERM grace.
     preempt_poll_interval: int = 0
+    # ---- run supervisor (trlx_tpu.supervisor, docs "Fault tolerance"):
+    # "stuck != dead" containment for unattended runs ----
+    # heartbeat watchdog: a learn-loop phase (rollout, reward_fn,
+    # ppo_update/ilql_update, eval, checkpoint_save) open longer than this
+    # many seconds is a STALL — all-thread stacks dump to stderr,
+    # telemetry flushes, fault/stalls increments, and stall_grace seconds
+    # later the run escalates per stall_action. 0 disables the watchdog.
+    stall_timeout: float = 0.0
+    # budget for the FIRST occurrence of each phase, which carries trace +
+    # XLA-compile cost (the same first-call separation telemetry keeps).
+    # 0 = 5 * stall_timeout.
+    stall_first_timeout: float = 0.0
+    # seconds between the stall dump and escalation. "checkpoint_exit"
+    # attempts a bounded rescue checkpoint from the watchdog thread and
+    # hard-exits 75 (EX_TEMPFAIL: schedulers restart; resume_from: auto
+    # continues); "abort" hard-exits 70 with no rescue. A stalled-but-
+    # alive loop (a hung seam whose timeout fires) instead exits cleanly
+    # through StallError containment before escalation is needed.
+    stall_grace: float = 60.0
+    stall_action: str = "checkpoint_exit"
+    # bounded-worker timeout for host seams (reward_fn calls, tracker
+    # emissions): a HUNG call — not just a failing one — raises
+    # SeamTimeout after this many seconds and consumes one host_retries
+    # attempt. 0 falls back to stall_timeout; both 0 = unbounded
+    # (reference-parity behavior).
+    host_call_timeout: float = 0.0
+    # bounded-worker timeout for checkpoint saves (a dead NFS/GCS mount
+    # must not silently wedge the run). 0 = unbounded.
+    checkpoint_timeout: float = 0.0
+    # walltime deadline: once the learn loop has run this many seconds it
+    # checkpoints and exits cleanly at the next step boundary (set below
+    # the reservation/queue limit; multi-host ranks agree through the
+    # preemption collective and exit together). 0 disables.
+    max_walltime: float = 0.0
+    # deterministic chaos-injection schedule for drills/CI, e.g.
+    # "reward_fn:hang=30@3;ppo_update:sigterm@2"
+    # (trlx_tpu.supervisor.chaos; $TRLX_TPU_CHAOS overrides). "" disables.
+    chaos: str = ""
     # unified telemetry (trlx_tpu.telemetry, docs "Observability"): the
     # learn loops emit per-iteration time/* phase durations, throughput/*
     # (tokens/sec, samples/sec, MFU), fault/* counters, and device/* HBM

@@ -100,8 +100,12 @@ class PPOOrchestrator(Orchestrator):
         The callback is the classic flaky host seam (a scoring service
         timing out, an HF pipeline hiccup): it gets
         train.host_retries retries with backoff before the run is
-        allowed to die (trlx_tpu.utils.faults.retry_call)."""
+        allowed to die (trlx_tpu.utils.faults.retry_call) — and, with
+        train.host_call_timeout / stall_timeout set, each attempt runs
+        through a bounded worker so a HUNG service is timed out and
+        retried instead of wedging the run (trlx_tpu.supervisor)."""
         from trlx_tpu.parallel import broadcast_host_floats
+        from trlx_tpu.supervisor import seam_timeout
         from trlx_tpu.utils.faults import retry_call
 
         t = self.rl_model.config.train
@@ -109,6 +113,8 @@ class PPOOrchestrator(Orchestrator):
             self.reward_fn, texts,
             retries=getattr(t, "host_retries", 2),
             backoff=getattr(t, "host_retry_backoff", 0.5),
+            timeout=seam_timeout(t),
+            seam="reward_fn",
             label="reward_fn",
         ))
 
@@ -171,19 +177,27 @@ class PPOOrchestrator(Orchestrator):
         scoring, reward finalization riding the dispatch back, store push;
         then the adaptive-KL update from the measured mean KL.
 
-        The harvest runs inside a ``rollout`` telemetry span (and each
-        host scoring call inside a nested ``reward_fn`` span): because the
-        dispatches are async, the harvest's fetches absorb the device
-        generation time, so ``time/rollout`` is the cycle's experience
-        phase (trlx_tpu.telemetry; no-op when disabled)."""
-        from trlx_tpu import telemetry
+        The harvest runs inside a ``rollout`` annotation — telemetry span
+        + supervisor phase heartbeat (and each host scoring call inside a
+        nested ``reward_fn`` one): because the dispatches are async, the
+        harvest's fetches absorb the device generation time, so
+        ``time/rollout`` is the cycle's experience phase and a wedged
+        fetch/score is a stalled ``rollout``/``reward_fn`` phase the
+        watchdog can attribute (trlx_tpu.telemetry, trlx_tpu.supervisor;
+        both no-ops when disabled). Each harvested chunk beats the
+        supervisor, so chunk-to-chunk progress resets the stall timer —
+        only a chunk that stops arriving trips it."""
+        from trlx_tpu.utils.profiling import annotate
 
-        with telemetry.span("rollout"):
+        with annotate("rollout"):
             return self._finish_experience(handle)
 
     def _finish_experience(self, handle):
-        from trlx_tpu import telemetry
+        from trlx_tpu import supervisor
+        from trlx_tpu.supervisor import chaos
+        from trlx_tpu.utils.profiling import annotate
 
+        chaos.maybe_inject("rollout")
         trainer = self.rl_model
         n_chunks = handle["n_chunks"]
 
@@ -224,7 +238,7 @@ class PPOOrchestrator(Orchestrator):
                 texts = trainer.tokenizer.batch_decode(
                     sequences, skip_special_tokens=True
                 )
-                with telemetry.span("reward_fn"):
+                with annotate("reward_fn"):
                     scores = self.score(texts)
             all_scores.append(scores)
 
@@ -247,6 +261,9 @@ class PPOOrchestrator(Orchestrator):
             )
             trainer.push_to_store(batch)
             self.clock.tick(len(sequences))
+            # per-chunk progress heartbeat: a multi-minute harvest of many
+            # chunks is healthy as long as chunks keep landing
+            supervisor.beat()
 
         # adaptive KL update from measured KL (parity: reference
         # accelerate_ppo_model.py:205 -> 130-135)

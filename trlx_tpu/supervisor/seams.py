@@ -1,0 +1,83 @@
+"""Bounded host-seam calls: timeouts that fire on a HUNG call.
+
+``retry_call`` (trlx_tpu.utils.faults) contains a host seam that *fails*;
+nothing before this module contained a seam that *hangs* — a reward_fn
+blocked on a dead scoring service, a tracker emission stuck in a TCP
+retry loop, a checkpoint save wedged on a dead NFS mount. A hung seam
+never raises, so retry budgets never start counting and the run burns
+the rest of its reservation doing nothing (ISSUE 3: "stuck ≠ dead").
+
+:func:`bounded_call` runs the callable in a fresh daemon worker thread
+and waits ``timeout`` seconds. On expiry it raises :class:`SeamTimeout`
+in the caller and ABANDONS the worker — Python cannot safely kill a
+thread, so the stuck call keeps its thread until it returns or the
+process exits (bounded in practice by the retry budget: each retried
+timeout abandons at most one daemon thread). A fresh thread per call is
+deliberate: a pooled worker poisoned by a hung call would starve every
+later seam, and thread-spawn cost is noise next to any host seam.
+
+Error taxonomy: :class:`SeamTimeout` IS-A :class:`StallError` IS-A
+``RuntimeError`` — a seam that times out past its retry budget
+propagates as a stall, and the learn loops contain every
+:class:`StallError` the same way (checkpoint-and-exit; see
+trlx_tpu.supervisor and docs "Fault tolerance").
+"""
+
+import threading
+from typing import Any, Callable
+
+
+class StallError(RuntimeError):
+    """A run phase stalled beyond containment: a host seam hung past its
+    timeout and retry budget, or the heartbeat watchdog escalated a
+    stalled phase. The learn loops convert this into a clean
+    checkpoint-and-exit — the checkpoint is resumable via
+    ``train.resume_from: auto``."""
+
+
+class SeamTimeout(StallError, TimeoutError):
+    """A bounded host-seam call exceeded its timeout while HUNG (as
+    opposed to raising). Counted in ``fault/seam_timeouts``; inside
+    ``retry_call`` it consumes one retry attempt like any failure."""
+
+
+def bounded_call(fn: Callable[[], Any], timeout: float, label: str = "") -> Any:
+    """``fn()`` in a fresh daemon worker, bounded by ``timeout`` seconds.
+
+    Returns the callable's result or re-raises its exception. On timeout
+    raises :class:`SeamTimeout` (and increments ``fault/seam_timeouts``);
+    the worker thread is abandoned and its eventual result discarded.
+    ``timeout <= 0`` is a plain unbounded call.
+    """
+    if timeout is None or timeout <= 0:
+        return fn()
+    outcome = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            outcome["value"] = fn()
+        except BaseException as e:  # re-raised in the caller below
+            outcome["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=run, daemon=True, name=f"trlx-seam-{label or 'call'}"
+    )
+    worker.start()
+    if not done.wait(timeout):
+        from trlx_tpu import telemetry
+
+        telemetry.inc("fault/seam_timeouts")
+        raise SeamTimeout(
+            f"host seam '{label or getattr(fn, '__name__', 'call')}' hung "
+            f"past its {timeout:.3g}s timeout (train.host_call_timeout / "
+            f"train.stall_timeout); the worker thread is abandoned. A "
+            f"hung — not failing — seam usually means a dead downstream "
+            f"service (scoring endpoint, tracker backend, checkpoint "
+            f"filesystem)."
+        )
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome.get("value")

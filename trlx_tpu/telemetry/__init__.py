@@ -61,8 +61,18 @@ _PREDECLARED_COUNTERS = (
     "fault/tracker_emissions_lost",
     "fault/tracker_degraded",
     "fault/preempt_sigterm",
+    # run-supervisor containment (trlx_tpu.supervisor): watchdog stall
+    # detections/escalations, hung-seam timeouts, walltime save-and-exits
+    "fault/stalls",
+    "fault/stall_escalations",
+    "fault/seam_timeouts",
+    "fault/walltime_exits",
     "checkpoint/saves",
     "checkpoint/restores",
+    # steady-state executable-cache misses after warmup
+    # (trlx_tpu.utils.aotjit): a sharding/layout drift that recompiles
+    # every step shows up as a counter climbing with iter, not silence
+    "compile/recompiles",
 )
 
 

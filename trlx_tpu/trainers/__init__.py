@@ -100,6 +100,17 @@ class BaseRLTrainer:
                 "nest inside the GPipe stage shard_map"
             )
         n_micro = self.config.train.pp_num_microbatches
+        if n_bottom_layers == 0:
+            # 0 % pp == 0, so without this check a fully-unfrozen model
+            # sails through the divisibility test below and silently
+            # pipelines an EMPTY trunk — the whole pp device slice idles
+            raise ValueError(
+                f"pipeline parallelism: num_layers_unfrozen leaves zero "
+                f"frozen trunk layers, but train.mesh pp={pp} pipelines "
+                f"only the frozen trunk — the entire pp device slice "
+                f"would sit idle. Freeze at least pp layers (lower "
+                f"num_layers_unfrozen) or set pp: 1."
+            )
         if n_bottom_layers % pp:
             raise ValueError(
                 f"pipeline parallelism: the frozen trunk has "
@@ -400,21 +411,40 @@ class BaseRLTrainer:
         `directory`, saves land as ``checkpoint_dir/step_<iter>`` with a
         LATEST marker and ``train.keep_checkpoints`` retention — the
         layout ``resume_from: auto`` and divergence rollback restore
-        from."""
+        from.
+
+        Supervised: the save runs as the watchdog's ``checkpoint_save``
+        phase and, with ``train.checkpoint_timeout`` set, through a
+        bounded worker — a save wedged on a dead filesystem raises
+        SeamTimeout instead of silently hanging the run
+        (trlx_tpu.supervisor)."""
+        from trlx_tpu import supervisor
+        from trlx_tpu.supervisor import bounded_call, chaos
         from trlx_tpu.utils.checkpoint import (
             save_components,
             save_step_checkpoint,
         )
 
-        if directory is not None:
-            save_components(self.get_components(), directory)
-            return
-        save_step_checkpoint(
-            self.get_components(),
-            self.config.train.checkpoint_dir,
-            step=getattr(self, "iter_count", 0),
-            keep=getattr(self.config.train, "keep_checkpoints", 0),
-        )
+        def write():
+            if directory is not None:
+                save_components(self.get_components(), directory)
+                return
+            save_step_checkpoint(
+                self.get_components(),
+                self.config.train.checkpoint_dir,
+                step=getattr(self, "iter_count", 0),
+                keep=getattr(self.config.train, "keep_checkpoints", 0),
+            )
+
+        with supervisor.phase("checkpoint_save"):
+            chaos.maybe_inject("checkpoint_save")
+            timeout = float(
+                getattr(self.config.train, "checkpoint_timeout", 0.0) or 0.0
+            )
+            if timeout > 0:
+                bounded_call(write, timeout=timeout, label="checkpoint_save")
+            else:
+                write()
 
     def load(self, directory: str = None) -> None:
         from trlx_tpu.utils.checkpoint import restore_components
@@ -517,18 +547,74 @@ class BaseRLTrainer:
                 )
         tel.finish()
 
-    def _preempt(self, log_fn, guard, just_saved: bool = False) -> bool:
-        """Checkpoint + True when a SIGTERM arrived on ANY process
-        (trlx_tpu.utils.preemption; resume via train.resume_from picks up
-        exactly here). `just_saved`: an interval checkpoint fired at this
-        same step boundary — skip the redundant second Orbax write (the
-        eviction grace period is short)."""
-        if guard is None or not guard.poll():
+    def _preempt(self, log_fn, guard, just_saved: bool = False,
+                 sup=None) -> bool:
+        """Checkpoint + True when ANY process wants the loop to stop:
+        SIGTERM preemption (trlx_tpu.utils.preemption), the supervisor's
+        walltime deadline (train.max_walltime), or a stall escalation
+        that found the loop still alive (trlx_tpu.supervisor). All three
+        ride the same rank-agreement collective (PreemptionGuard.poll),
+        so multi-host ranks exit together; resume via
+        train.resume_from picks up exactly here. `just_saved`: an
+        interval checkpoint fired at this same step boundary — skip the
+        redundant second Orbax write (the eviction grace period is
+        short)."""
+        local = sup is not None and sup.stop_requested()
+        if guard is None:
+            stop = local
+        else:
+            stop = guard.poll(extra=local)
+        if not stop:
             return False
         if not just_saved:
             self.save()
-        log_fn({"iter": self.iter_count, "preempted": 1.0})
+        reason = sup.stop_reason() if local else "preempted"
+        log_fn({"iter": self.iter_count, reason: 1.0})
         return True
+
+    def _make_supervisor(self):
+        """The learn loops' run supervisor (trlx_tpu.supervisor), built
+        from the train.stall_* / max_walltime knobs — inert (but still a
+        valid context manager) when they are all 0. Also installs the
+        chaos schedule from $TRLX_TPU_CHAOS / train.chaos, counters
+        fresh, so every learn() call injects at the same schedule points.
+        The rescue hook is a bounded best-effort save for the
+        checkpoint-exit escalation path — it runs on the watchdog thread
+        while the main thread is wedged, so it is itself bounded."""
+        from trlx_tpu.supervisor import RunSupervisor, bounded_call, chaos
+
+        chaos.configure_from(self.config.train)
+
+        def rescue():
+            bounded_call(
+                self.save,
+                timeout=float(
+                    getattr(self.config.train, "checkpoint_timeout", 0.0)
+                    or 120.0
+                ),
+                label="stall rescue checkpoint",
+            )
+
+        return RunSupervisor.from_config(
+            self.config.train, rescue_fn=rescue
+        )
+
+    def _contain_stall(self, log_fn) -> None:
+        """StallError containment at learn() level: a hung seam past its
+        retry budget (SeamTimeout) becomes a clean checkpoint-and-exit —
+        commit a resumable checkpoint (best-effort: the stall may be the
+        checkpoint path itself), emit the verdict, and let the caller
+        re-raise so the operator/scheduler sees a failed-but-resumable
+        run (train.resume_from: auto picks up exactly here)."""
+        try:
+            self.save()
+        except Exception as e:
+            print(
+                f"[trlx_tpu] stall-exit checkpoint failed ({e!r}); the "
+                f"last interval checkpoint remains the resume point",
+                flush=True,
+            )
+        log_fn({"iter": self.iter_count, "stalled": 1.0})
 
     def maybe_resume(self) -> bool:
         """Restore from config.train.resume_from once, at trainer

@@ -392,14 +392,17 @@ class JaxILQLTrainer(BaseRLTrainer):
         (explicit opt-in for final/offline evaluation)."""
         if self.eval_pipeline is None or len(self.eval_pipeline) == 0:
             return {}
-        from trlx_tpu import telemetry
+        from trlx_tpu.supervisor import seam_timeout
+        from trlx_tpu.utils.profiling import annotate
 
         prompts = self.eval_pipeline.texts
         if n is None:
             n = self.EVAL_CAP
         if n:
             prompts = prompts[:n]
-        with telemetry.span("eval"):
+        # annotate = telemetry span + supervisor heartbeat (a hung eval
+        # or reward call is a stalled phase, not a silent wedge)
+        with annotate("eval"):
             samples = self.sample(prompts)
             sample_lists = [list(map(int, row)) for row in samples]
             logs = {}
@@ -409,7 +412,7 @@ class JaxILQLTrainer(BaseRLTrainer):
             if self.reward_fn is not None:
                 from trlx_tpu.utils.faults import retry_call
 
-                with telemetry.span("reward_fn"):
+                with annotate("reward_fn"):
                     rewards = np.asarray(
                         retry_call(
                             self.reward_fn,
@@ -420,6 +423,8 @@ class JaxILQLTrainer(BaseRLTrainer):
                             backoff=getattr(
                                 self.config.train, "host_retry_backoff", 0.5
                             ),
+                            timeout=seam_timeout(self.config.train),
+                            seam="reward_fn",
                             label="reward_fn (eval)",
                         ),
                         np.float32,
@@ -444,7 +449,12 @@ class JaxILQLTrainer(BaseRLTrainer):
         (train.save_on_preemption, trlx_tpu.utils.preemption). With
         train.max_bad_steps > 0, non-finite updates are skipped on device
         and contained by rollback-to-checkpoint
-        (trlx_tpu.utils.faults.StepGuard, same containment as PPO)."""
+        (trlx_tpu.utils.faults.StepGuard, same containment as PPO). The
+        run supervisor (trlx_tpu.supervisor) rides the same loop:
+        train.stall_timeout arms the heartbeat watchdog,
+        train.max_walltime save-and-exits before the reservation ends,
+        and a hung host seam past its retry budget converts to a clean
+        checkpoint-and-exit (StallError)."""
         from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import maybe_trace
 
@@ -452,28 +462,39 @@ class JaxILQLTrainer(BaseRLTrainer):
         # capped like the PPO loop: bounded detection latency vs eviction
         # grace windows; train.preempt_poll_interval overrides
         cfg = self.config.train
+        sup = self._make_supervisor()
         with maybe_trace(), PreemptionGuard(
             cfg.save_on_preemption,
             poll_interval=(cfg.preempt_poll_interval
                            or min(cfg.log_interval, 8)),
-        ) as guard:
-            self._learn_loop(log_fn, save_fn, eval_fn, guard)
+        ) as guard, sup:
+            self._learn_loop(log_fn, save_fn, eval_fn, guard, sup)
 
     def _learn_loop(self, log_fn=None, save_fn=None, eval_fn=None,
-                    guard=None):
+                    guard=None, sup=None):
+        from trlx_tpu.supervisor import StallError
+
         cfg = self.config.train
         m = self.config.method
         log_fn = self._main_process_log(log_fn or make_tracker(self.config))
         step_guard = self._make_step_guard(log_fn)
         clock = Clock()
         try:
-            self._learn_epochs(log_fn, guard, step_guard, clock, cfg, m)
+            self._learn_epochs(log_fn, guard, step_guard, clock, cfg, m,
+                               sup)
+        except StallError:
+            # hung seam past its retry budget: checkpoint-and-exit (the
+            # run is resumable via train.resume_from: auto)
+            self._contain_stall(log_fn)
+            raise
         finally:
-            # every exit path (completion, preemption, DivergenceError)
-            # leaves the run's telemetry.json + trace.jsonl behind
+            # every exit path (completion, preemption, DivergenceError,
+            # StallError) leaves the run's telemetry.json + trace.jsonl
             self._finish_telemetry("ilql", clock)
 
-    def _learn_epochs(self, log_fn, guard, step_guard, clock, cfg, m):
+    def _learn_epochs(self, log_fn, guard, step_guard, clock, cfg, m,
+                      sup=None):
+        from trlx_tpu.supervisor import chaos
         from trlx_tpu.utils.profiling import annotate
 
         eos = getattr(self.tokenizer, "eos_token_id", 0) or 0
@@ -525,6 +546,7 @@ class JaxILQLTrainer(BaseRLTrainer):
                         log_fn({"iter": self.iter_count, **ev})
 
                 with annotate("ilql_update"):
+                    chaos.maybe_inject("ilql_update")
                     if device_resident:
                         self.params, self.opt_state, stats = (
                             self._train_step_indexed(
@@ -569,7 +591,8 @@ class JaxILQLTrainer(BaseRLTrainer):
                 )
                 if saved_now:
                     self.save()
-                if self._preempt(log_fn, guard, just_saved=saved_now):
+                if self._preempt(log_fn, guard, just_saved=saved_now,
+                                 sup=sup):
                     return
                 if self.iter_count >= cfg.total_steps:
                     return

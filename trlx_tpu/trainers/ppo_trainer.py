@@ -568,11 +568,15 @@ class JaxPPOTrainer(BaseRLTrainer):
                 eval_prompts = next(iter(loader))
             except StopIteration:
                 return {}
-        from trlx_tpu import telemetry
+        from trlx_tpu.supervisor import chaos, seam_timeout
         from trlx_tpu.utils.faults import retry_call
+        from trlx_tpu.utils.profiling import annotate
 
         query, mask = eval_prompts
-        with telemetry.span("eval"):
+        # annotate = telemetry span + supervisor heartbeat: a hung eval
+        # or reward call shows up as a stalled phase, not a silent wedge
+        with annotate("eval"):
+            chaos.maybe_inject("eval")
             out = self.generate(query, mask)
             sequences, gen_tokens = jax.device_get(
                 (out.sequences, out.gen_tokens)
@@ -580,13 +584,15 @@ class JaxPPOTrainer(BaseRLTrainer):
             texts = self.tokenizer.batch_decode(
                 sequences, skip_special_tokens=True
             )
-            with telemetry.span("reward_fn"):
+            with annotate("reward_fn"):
                 scores = np.asarray(retry_call(
                     self.reward_fn, texts,
                     retries=getattr(self.config.train, "host_retries", 2),
                     backoff=getattr(
                         self.config.train, "host_retry_backoff", 0.5
                     ),
+                    timeout=seam_timeout(self.config.train),
+                    seam="reward_fn",
                     label="reward_fn (eval)",
                 ), np.float32)
         query_texts = self.tokenizer.batch_decode(
@@ -632,7 +638,13 @@ class JaxPPOTrainer(BaseRLTrainer):
         train.max_bad_steps > 0, non-finite / KL-breaching updates are
         skipped on device and contained by rollback-to-checkpoint
         (trlx_tpu.utils.faults.StepGuard); a run that re-diverges after
-        rollback raises DivergenceError instead of training on garbage."""
+        rollback raises DivergenceError instead of training on garbage.
+        The run supervisor (trlx_tpu.supervisor) rides the same loop:
+        train.stall_timeout arms a heartbeat watchdog over the loop's
+        phases, train.max_walltime save-and-exits before the reservation
+        ends, and a hung host seam past its retry budget is converted to
+        a clean checkpoint-and-exit (StallError)."""
+        from trlx_tpu.supervisor import StallError
         from trlx_tpu.utils.preemption import PreemptionGuard
         from trlx_tpu.utils.profiling import annotate, maybe_trace
 
@@ -642,6 +654,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         clock = Clock()
         self.maybe_resume()  # no-op when already restored at construction
         step_guard = self._make_step_guard(log_fn)
+        sup = self._make_supervisor()
 
         # auto poll_interval is capped so preemption-detection latency
         # stays bounded relative to eviction grace windows (a spot node
@@ -652,16 +665,35 @@ class JaxPPOTrainer(BaseRLTrainer):
                 cfg.save_on_preemption,
                 poll_interval=(cfg.preempt_poll_interval
                                or min(cfg.log_interval, 8)),
-            ) as guard:
+            ) as guard, sup:
                 self._learn_loop(log_fn, cfg, m, clock, annotate, guard,
-                                 step_guard)
+                                 step_guard, sup)
+        except StallError:
+            # hung seam past its retry budget: checkpoint-and-exit (the
+            # run is resumable; the re-raise tells the operator why it
+            # stopped)
+            self._contain_stall(log_fn)
+            raise
         finally:
-            # every exit path (completion, preemption, DivergenceError)
-            # leaves the run's telemetry.json + trace.jsonl behind
+            # every exit path (completion, preemption, DivergenceError,
+            # StallError) leaves the run's telemetry.json + trace.jsonl
             self._finish_telemetry("ppo", clock)
 
+    @staticmethod
+    def _epoch_batch_count(n_rows: int, batch_size: int) -> int:
+        """Optimization-batch steps one epoch runs over `n_rows` store
+        rows — the SINGLE definition of the epoch length. Both
+        `_batch_runner` paths iterate with drop-last semantics
+        (batch_iterator drop_last=True), and `_will_refresh` predicts the
+        epoch-end iter_count from this same helper, so the
+        continuous-rollout refresh prediction can never drift from the
+        loaders' actual batch count."""
+        return n_rows // batch_size
+
     def _batch_runner(self, cfg):
-        """(iterator, run, rows): one optimization-batch step per item.
+        """(iterator, run, rows): one optimization-batch step per item;
+        both paths yield exactly `_epoch_batch_count(len(store),
+        batch_size)` items (last partial batch dropped).
 
         Device-resident store + no mesh: the iterator yields INDEX arrays
         and `run` gathers the rows inside the single train dispatch
@@ -679,7 +711,7 @@ class JaxPPOTrainer(BaseRLTrainer):
         ):
             iterator = batch_iterator(
                 len(data), cfg.batch_size, True, self.epoch,
-                lambda idx: idx,
+                lambda idx: idx, drop_last=True,
             )
 
             def run(idx):
@@ -689,6 +721,8 @@ class JaxPPOTrainer(BaseRLTrainer):
                 )
 
             return iterator, run, len
+        # store.create_loader delegates to batch_iterator with the same
+        # drop_last=True default — the contract _epoch_batch_count states
         iterator = self.store.create_loader(
             cfg.batch_size, shuffle=True, seed=self.epoch
         )
@@ -703,18 +737,20 @@ class JaxPPOTrainer(BaseRLTrainer):
     def _will_refresh(self, cfg, m) -> bool:
         """Whether the post-epoch experience refresh will run, PREDICTED
         before the epoch's updates: the epoch advances iter_count by
-        exactly n_batches * ppo_epochs (both sides of the batch runner
-        drop the last partial batch), so the continuation condition is
-        computable up-front — which is what lets continuous mode dispatch
-        the next epoch's rollouts before this epoch's updates."""
+        exactly `_epoch_batch_count * ppo_epochs`, so the continuation
+        condition is computable up-front — which is what lets continuous
+        mode dispatch the next epoch's rollouts before this epoch's
+        updates."""
         if self.orch is None:
             return False
-        n_batches = len(self.store) // cfg.batch_size
+        n_batches = self._epoch_batch_count(len(self.store), cfg.batch_size)
         end_count = self.iter_count + n_batches * m.ppo_epochs
         return end_count < cfg.total_steps and self.epoch + 1 < cfg.epochs
 
     def _learn_loop(self, log_fn, cfg, m, clock, annotate, guard=None,
-                    step_guard=None):
+                    step_guard=None, sup=None):
+        from trlx_tpu.supervisor import chaos
+
         while self.iter_count < cfg.total_steps and self.epoch < cfg.epochs:
             loader, run, rows = self._batch_runner(cfg)
             pending_exp = None
@@ -732,6 +768,7 @@ class JaxPPOTrainer(BaseRLTrainer):
                     )
             for item in loader:
                 with annotate("ppo_update"):
+                    chaos.maybe_inject("ppo_update")
                     # all ppo_epochs passes in ONE dispatch — per-dispatch
                     # latency on tunneled devices makes N separate train
                     # steps measurably slower than one scanned program
@@ -769,7 +806,8 @@ class JaxPPOTrainer(BaseRLTrainer):
                 if intervals["do_save"]:
                     self.save()
                 if self._preempt(log_fn, guard,
-                                 just_saved=intervals["do_save"]):
+                                 just_saved=intervals["do_save"],
+                                 sup=sup):
                     return
                 if self.iter_count >= cfg.total_steps:
                     break
@@ -786,7 +824,7 @@ class JaxPPOTrainer(BaseRLTrainer):
                     info = self.orch.finish_experience(pending_exp)
                 log_fn({"iter": self.iter_count, "epoch": self.epoch, **info,
                         **self._telemetry_stats(clock.samples_per_second())})
-                if self._preempt(log_fn, guard):
+                if self._preempt(log_fn, guard, sup=sup):
                     return
             elif self.orch is not None and self.iter_count < cfg.total_steps \
                     and self.epoch < cfg.epochs:
@@ -800,7 +838,7 @@ class JaxPPOTrainer(BaseRLTrainer):
                 # time/* / throughput/* / fault/* every epoch
                 log_fn({"iter": self.iter_count, "epoch": self.epoch, **info,
                         **self._telemetry_stats(clock.samples_per_second())})
-                if self._preempt(log_fn, guard):
+                if self._preempt(log_fn, guard, sup=sup):
                     return
 
     def post_rollout_kl_update(self, mean_kl: float, n_samples: int) -> None:

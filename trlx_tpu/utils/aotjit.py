@@ -77,6 +77,18 @@ class _AotJit:
         key = (treedef, tuple(_leaf_sig(x) for x in leaves))
         compiled = self._cache.get(key)
         if compiled is None:
+            if self._cache:
+                # steady-state miss: an executable already exists but this
+                # call's signature (shape/dtype/layout/sharding) matches
+                # none of them. A sharding or layout that drifts each step
+                # recompiles EVERY dispatch — silent, and catastrophic on
+                # tunneled runtimes — so surface it as a counter climbing
+                # with iter (telemetry "compile/recompiles"; no-op when
+                # telemetry is off). Legitimate new shapes (a differently
+                # sized eval batch) add a few counts and then stabilize.
+                from trlx_tpu import telemetry
+
+                telemetry.inc("compile/recompiles")
             compiled = self._jitted.lower(*args).compile()
             self._cache[key] = compiled
         return compiled(*args)

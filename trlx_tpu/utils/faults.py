@@ -18,6 +18,10 @@ is exactly wrong for:
   OUTSIDE the jitted world and fail transiently. :func:`retry_call` gives
   them bounded retry-with-backoff; trackers additionally degrade to stdout
   (trlx_tpu.utils.trackers.ResilientTracker) rather than killing the run.
+  A seam that HANGS instead of failing is bounded too: ``timeout=`` runs
+  each attempt through a worker thread and a hung call raises
+  ``SeamTimeout`` (trlx_tpu.supervisor.seams), which the learn loops
+  convert into a clean checkpoint-and-exit.
 
 Every containment event also increments a ``fault/*`` telemetry counter
 (``fault/skipped_steps``, ``fault/rollbacks``, ``fault/divergence_aborts``,
@@ -43,19 +47,46 @@ def retry_call(
     backoff: float = 0.5,
     label: str = "",
     log: Callable[[str], None] = print,
+    timeout: float = 0.0,
+    seam: str = "",
     **kwargs: Any,
 ):
     """``fn(*args, **kwargs)`` with up to ``retries`` retries on exception,
     exponential backoff between attempts (``backoff * 2**attempt`` seconds),
     and the LAST exception re-raised when the budget is exhausted — a
     persistently-broken seam must still fail loudly, just not on its first
-    hiccup. ``retries=0`` is a plain call."""
+    hiccup. ``retries=0`` is a plain call.
+
+    ``timeout > 0`` runs each attempt through a bounded worker
+    (trlx_tpu.supervisor.seams.bounded_call), so a HUNG seam — one that
+    never raises — times out with :class:`SeamTimeout` and consumes one
+    retry like any failure; exhaustion re-raises it, and SeamTimeout
+    IS-A StallError, which the learn loops contain as a clean
+    checkpoint-and-exit (docs "Fault tolerance").
+
+    ``seam`` names a chaos-injection point fired before each attempt
+    (trlx_tpu.supervisor.chaos — free unless a schedule is active);
+    firing INSIDE the attempt means injected hangs are bounded by
+    ``timeout`` and injected exceptions consume retries, exactly like
+    the real faults they stand in for."""
     from trlx_tpu import telemetry
+    from trlx_tpu.supervisor import bounded_call
+    from trlx_tpu.supervisor import chaos
+
+    def attempt_once():
+        if seam:
+            chaos.maybe_inject(seam)
+        return fn(*args, **kwargs)
 
     attempt = 0
     while True:
         try:
-            return fn(*args, **kwargs)
+            if timeout and timeout > 0:
+                return bounded_call(
+                    attempt_once, timeout=timeout,
+                    label=label or seam or getattr(fn, "__name__", "call"),
+                )
+            return attempt_once()
         except Exception as e:
             attempt += 1
             if attempt > retries:

@@ -48,9 +48,9 @@ class PreemptionGuard:
 
         telemetry.inc("fault/preempt_sigterm")
 
-    def poll(self) -> bool:
-        """The preemption flag AGREED across JAX processes: any rank's
-        SIGTERM preempts every rank.
+    def poll(self, extra: bool = False) -> bool:
+        """The stop flag AGREED across JAX processes: any rank's SIGTERM
+        (or locally-raised ``extra`` condition) stops every rank.
 
         A node drain signals hosts at different times (or only one); a
         rank acting alone would exit mid-collective — deadlocking the
@@ -62,11 +62,18 @@ class PreemptionGuard:
         boundaries are collective ones; between them poll() returns False
         even if the local flag is set, because a rank acting on local state
         alone is exactly the deadlock this method exists to prevent).
-        Single-process: just the local flag, every call."""
+        Single-process: just the local flags, every call.
+
+        ``extra`` folds additional rank-local stop conditions into the
+        same agreement — the run supervisor's walltime deadline and stall
+        escalation ride it (trlx_tpu.supervisor), so e.g. one rank
+        crossing ``train.max_walltime`` a moment before the others still
+        makes every rank exit together at the same boundary."""
         import jax
 
+        local = self.requested or bool(extra)
         if jax.process_count() == 1:
-            return self.requested
+            return local
         self._polls += 1
         if (self._polls - 1) % self._poll_interval:
             return False
@@ -74,7 +81,7 @@ class PreemptionGuard:
         from jax.experimental import multihost_utils
 
         flags = multihost_utils.process_allgather(
-            np.asarray([1.0 if self.requested else 0.0], np.float32)
+            np.asarray([1.0 if local else 0.0], np.float32)
         )
         return bool(np.asarray(flags).max() > 0)
 
@@ -89,12 +96,19 @@ class PreemptionGuard:
         return self
 
     def __exit__(self, *exc) -> bool:
+        """Restore the previous SIGTERM disposition.
+
+        Embedder caveat: ``signal.getsignal()`` returns ``None`` for a
+        handler installed at the C level (outside the Python signal
+        module — e.g. by a host application or an extension library), and
+        such a handler CANNOT be re-installed from Python. After
+        ``learn()`` returns, a C-level previous handler is therefore
+        replaced by ``SIG_DFL`` rather than left as this guard's
+        recording handler — nobody polls the flag anymore, and a
+        swallowed SIGTERM would make the process undrainable. A host
+        application that installed its own C-level SIGTERM handler must
+        reinstall it after ``learn()`` returns."""
         if self._installed:
-            # getsignal() returns None for handlers installed outside
-            # Python (C level); those cannot be re-installed via signal().
-            # Fall back to SIG_DFL rather than leaving our recording handler
-            # live — after learn() returns nobody polls the flag, and a
-            # swallowed SIGTERM would make the process undrainable.
             signal.signal(
                 signal.SIGTERM,
                 self._prev if self._prev is not None else signal.SIG_DFL,

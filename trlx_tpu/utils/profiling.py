@@ -77,13 +77,19 @@ class _Stacked:
 
 def annotate(name: str):
     """Named host-span annotation: a telemetry span (no-op without an
-    active session) plus, while a maybe_trace() region is open, a
-    jax.profiler.TraceAnnotation visible in the device trace timeline."""
-    from trlx_tpu import telemetry
+    active session), a run-supervisor phase heartbeat (no-op without an
+    active supervisor — trlx_tpu.supervisor: the watchdog times the
+    innermost open phase against train.stall_timeout) plus, while a
+    maybe_trace() region is open, a jax.profiler.TraceAnnotation visible
+    in the device trace timeline."""
+    from trlx_tpu import supervisor, telemetry
 
     span = telemetry.span(name)
+    heartbeat = supervisor.phase(name)
     if not _tracing_active:
-        return span
+        if heartbeat is supervisor.NULL_CM:
+            return span
+        return _Stacked(span, heartbeat)
     import jax
 
-    return _Stacked(span, jax.profiler.TraceAnnotation(name))
+    return _Stacked(span, heartbeat, jax.profiler.TraceAnnotation(name))

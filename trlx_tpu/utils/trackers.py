@@ -154,12 +154,17 @@ class ResilientTracker:
 
     def __init__(self, inner, retries: int = 1, backoff: float = 0.5,
                  max_consecutive_failures: int = 3,
-                 fallback_factory=PrintTracker):
+                 fallback_factory=PrintTracker, timeout: float = 0.0):
         self.inner = inner
         self.retries = retries
         self.backoff = backoff
         self.max_consecutive_failures = max_consecutive_failures
         self.fallback_factory = fallback_factory
+        # > 0: each emission attempt runs through a bounded worker — a
+        # sink that HANGS (wandb stuck in a TCP retry loop) times out,
+        # counts as a lost emission, and degrades like any failure
+        # (trlx_tpu.supervisor.seams; train.host_call_timeout)
+        self.timeout = timeout
         self.failures = 0
         self.degraded = False
         self._failed_inner = None  # the original sink, kept for finish()
@@ -173,7 +178,8 @@ class ResilientTracker:
             return
         try:
             retry_call(self.inner, stats, retries=self.retries,
-                       backoff=self.backoff, label="tracker emission")
+                       backoff=self.backoff, label="tracker emission",
+                       timeout=self.timeout, seam="tracker")
             self.failures = 0
         except Exception as e:
             self.failures += 1
@@ -238,10 +244,13 @@ def make_tracker(config=None, kind: Optional[str] = None):
     kind = kind if kind is not None else getattr(train, "tracker", "print")
 
     def resilient(inner):
+        from trlx_tpu.supervisor import seam_timeout
+
         return ResilientTracker(
             inner,
             retries=getattr(train, "host_retries", 1),
             backoff=getattr(train, "host_retry_backoff", 0.5),
+            timeout=seam_timeout(train),
         )
 
     if kind in (None, "none", ""):
