@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults telemetry chaos
+.PHONY: style check test faults telemetry chaos serve
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -14,8 +14,10 @@ style:
 		&& ruff check --fix trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| python -m pytest tests/test_style.py -q
 
+# the tier-1 contract (ROADMAP.md): CPU-pinned so a dev-box run never
+# grabs an accelerator, and 'not slow' so it matches what CI gates on
 test:
-	python -m pytest tests/ -x -q
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -x -q -m 'not slow'
 
 # fault-injection tier: atomic-checkpoint crash scenarios, divergence
 # containment (NaN skip / rollback / second-strike abort), flaky host
@@ -40,3 +42,12 @@ telemetry:
 # the non-slow tier-1 set; this target runs just them.
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
+
+# inference-serving tier (trlx_tpu/serve, docs "Serving"): bucketed AOT
+# decode engine (checkpoint restore + strip, zero steady-state
+# recompiles), dynamic micro-batcher (deadline flush, bucket rounding,
+# queue-overflow admission control), HTTP endpoint parity e2e, and the
+# serve_decode/serve_request chaos containment paths. Part of the
+# non-slow tier-1 set; this target runs just them.
+serve:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q

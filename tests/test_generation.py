@@ -199,6 +199,51 @@ def test_fori_decode_path_matches_unrolled(arch, monkeypatch):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_from_gen_kwargs_honors_max_new_tokens():
+    """HF-style max_new_tokens (what serving clients pass) overrides
+    gen_size; exceeding the compiled ceiling raises instead of being
+    silently ignored (the pre-serving behavior)."""
+    cfg = GenerationConfig.from_gen_kwargs(16, {"max_new_tokens": 8})
+    assert cfg.gen_size == 8
+    # fixed-length configs keep min_new == (overridden) gen_size
+    cfg = GenerationConfig.from_gen_kwargs(
+        16, {"max_new_tokens": 8, "min_length": 24, "max_length": 24}
+    )
+    assert cfg.gen_size == 8 and cfg.min_new_tokens == 8
+    with pytest.raises(ValueError, match="exceeds the compiled"):
+        GenerationConfig.from_gen_kwargs(8, {"max_new_tokens": 9})
+    with pytest.raises(ValueError, match="must be >= 1"):
+        GenerationConfig.from_gen_kwargs(8, {"max_new_tokens": 0})
+    # absent key: unchanged behavior
+    assert GenerationConfig.from_gen_kwargs(8, {}).gen_size == 8
+
+
+def test_greedy_skips_warps_unchanged():
+    """do_sample=False skips temperature/top-k/top-p entirely — all are
+    argmax-invariant — so greedy output must match the old warped-argmax
+    path exactly (the regression the fast path must not break)."""
+    from trlx_tpu.ops.sampling import sample_token
+
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, 97))
+    for p in (
+        SamplingParams(do_sample=False),
+        SamplingParams(do_sample=False, temperature=0.37),
+        SamplingParams(do_sample=False, top_k=5),
+        SamplingParams(do_sample=False, top_p=0.42),
+        SamplingParams(do_sample=False, temperature=2.0, top_k=3,
+                       top_p=0.9),
+    ):
+        got = np.asarray(sample_token(rng, logits, p))
+        warped_argmax = np.asarray(
+            jnp.argmax(warp_logits(logits, p), axis=-1)
+        )
+        np.testing.assert_array_equal(got, warped_argmax)
+        np.testing.assert_array_equal(
+            got, np.asarray(jnp.argmax(logits, axis=-1))
+        )
+
+
 def test_sampling_key_accepts_raw_rbg_data():
     """ADVICE r04: raw 4-word uint32 key data is already rbg-shaped — it
     must wrap as-is (tiling to 8 words raises inside wrap_key_data), and

@@ -1,0 +1,581 @@
+"""Inference-serving tests (trlx_tpu/serve): bucket lattice + AOT decode
+engine, dynamic micro-batcher semantics (deadline flush, bucket rounding,
+admission control), HTTP endpoint routes, chaos-driven containment, and
+the checkpoint->endpoint parity e2e the subsystem exists for.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu import telemetry
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.serve import (
+    InferenceEngine,
+    InferenceServer,
+    MicroBatcher,
+    QueueFull,
+    ServeConfig,
+)
+from trlx_tpu.supervisor import RunSupervisor, chaos
+
+
+def tiny_config_dict(do_sample=False):
+    return {
+        "model": {
+            "model_path": "from-config",
+            "tokenizer_path": "byte",
+            "model_type": "JaxPPOTrainer",
+            "num_layers_unfrozen": 1,
+            "model_spec": {
+                "vocab_size": 257,
+                "n_layer": 2,
+                "n_head": 4,
+                "d_model": 64,
+                "n_positions": 32,
+            },
+            "compute_dtype": "float32",
+        },
+        "train": {
+            "n_ctx": 32,
+            "epochs": 1,
+            "total_steps": 4,
+            "batch_size": 8,
+            "grad_clip": 1.0,
+            "lr_ramp_steps": 0,
+            "lr_decay_steps": 4,
+            "weight_decay": 1e-6,
+            "learning_rate_init": 1e-3,
+            "learning_rate_target": 1e-3,
+            "log_interval": 1000,
+            "checkpoint_interval": 10**9,
+            "eval_interval": 10**9,
+            "pipeline": "PPOPipeline",
+            "orchestrator": "PPOOrchestrator",
+            "input_size": 4,
+            "gen_size": 8,
+            "seed": 0,
+            "telemetry": False,
+        },
+        "method": {
+            "name": "ppoconfig",
+            "num_rollouts": 8,
+            "chunk_size": 8,
+            "ppo_epochs": 1,
+            "gen_kwargs": {
+                "max_length": 8,
+                "min_length": 8,
+                "top_k": 0,
+                "top_p": 1.0,
+                "do_sample": do_sample,
+            },
+        },
+    }
+
+
+SERVE = ServeConfig(
+    buckets=[[2, 8, 8], [4, 8, 8], [4, 16, 8]],
+    max_wait_ms=40.0,
+    max_queue=64,
+    request_timeout=30.0,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One tiny greedy-decode engine shared by the unit tests (warm
+    executables amortized across them)."""
+    telemetry.start()
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    return InferenceEngine(cfg, serve=SERVE)
+
+
+@pytest.fixture()
+def fresh_registry():
+    session = telemetry.start()
+    yield session.registry
+    telemetry.start()
+
+
+@pytest.fixture()
+def batcher(engine):
+    b = MicroBatcher(engine).start()
+    yield b
+    b.stop()
+
+
+# --------------------------------------------------------------------- #
+# engine: lattice + shaping
+# --------------------------------------------------------------------- #
+
+
+def test_pick_shape_rounds_up_to_smallest_fit(engine):
+    assert engine.pick_shape(3, 5) == (8, 8)
+    assert engine.pick_shape(8, 8) == (8, 8)
+    assert engine.pick_shape(9, 8) == (16, 8)
+    with pytest.raises(ValueError, match="fits no serve bucket"):
+        engine.pick_shape(17, 8)
+    with pytest.raises(ValueError, match="fits no serve bucket"):
+        engine.pick_shape(4, 9)
+
+
+def test_batch_sizes_ascend_per_shape_class(engine):
+    assert engine.batch_sizes_for((8, 8)) == (2, 4)
+    assert engine.batch_sizes_for((16, 8)) == (4,)
+    assert engine.max_new_tokens_cap() == 8
+    assert engine.default_max_new_tokens() == 8
+
+
+def test_pad_batch_left_pads_and_fills(engine):
+    bucket = (4, 8, 8)
+    tokens, mask = engine.pad_batch([[1, 2, 3], [4]], bucket)
+    assert tokens.shape == mask.shape == (4, 8)
+    assert list(tokens[0, -3:]) == [1, 2, 3] and mask[0, :5].sum() == 0
+    assert tokens[1, -1] == 4 and mask[1].sum() == 1
+    # filler rows repeat row 0 (never read back)
+    np.testing.assert_array_equal(tokens[2], tokens[0])
+    np.testing.assert_array_equal(tokens[3], tokens[0])
+
+
+def test_bucket_validation():
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    with pytest.raises(ValueError, match="n_positions"):
+        InferenceEngine(
+            cfg, serve=ServeConfig(buckets=[[2, 32, 32]]), init=False
+        )
+    with pytest.raises(ValueError, match="triple"):
+        InferenceEngine(
+            cfg, serve=ServeConfig(buckets=[[2, 8]]), init=False
+        )
+
+
+def test_engine_rejects_non_ppo_method():
+    cfg_dict = tiny_config_dict()
+    cfg_dict["method"] = {"name": "ilqlconfig"}
+    cfg = TRLConfig.from_dict(cfg_dict)
+    with pytest.raises(NotImplementedError, match="hydra"):
+        InferenceEngine(cfg, serve=SERVE, init=False)
+
+
+def test_warmup_compiles_each_bucket_once(engine, fresh_registry):
+    engine._decode_fns = {}
+    engine.warmed = False
+    latencies = engine.warmup()
+    assert engine.warmed
+    assert set(latencies) == {
+        engine.span_name(b) for b in engine.buckets
+    }
+    # warming bucket N+1 is a first compile in ITS OWN cache, never a
+    # steady-state miss — the serving invariant
+    assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+    # and a steady-state call after warmup does not recompile either
+    b = engine.buckets[0]
+    tokens, mask = engine.pad_batch([[1, 2]], b)
+    engine.decode(b, tokens, mask, seed=3)
+    assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+    # per-bucket first-call (compile) latency recorded apart by the tracer
+    assert f"compile/{engine.span_name(b)}_first_s" in fresh_registry.gauges
+
+
+# --------------------------------------------------------------------- #
+# micro-batcher semantics
+# --------------------------------------------------------------------- #
+
+
+def test_deadline_flush_partial_batch(engine, fresh_registry, batcher):
+    t0 = time.monotonic()
+    req = batcher.submit([1, 2, 3], max_new_tokens=4)
+    req.wait(timeout=30.0)
+    assert req.result is not None and len(req.result) <= 4
+    assert time.monotonic() - t0 < 25.0
+    # one request in a batch-2 bucket: fill ratio 0.5
+    assert fresh_registry.gauges["serve/batch_fill_ratio"] == 0.5
+    assert fresh_registry.counters["serve/batches"] == 1.0
+    assert fresh_registry.counters["serve/responses"] == 1.0
+    assert "serve/request_latency" in fresh_registry.hists
+
+
+def test_full_bucket_flushes_before_deadline(engine, fresh_registry):
+    b = MicroBatcher(engine, max_wait_ms=30_000.0).start()
+    try:
+        t0 = time.monotonic()
+        reqs = [b.submit([i + 1], max_new_tokens=2) for i in range(4)]
+        for r in reqs:
+            r.wait(timeout=30.0)
+        # the largest (8, 8) extent is 4: filling it must flush without
+        # waiting out the 30s deadline
+        assert time.monotonic() - t0 < 20.0
+        assert fresh_registry.gauges["serve/batch_fill_ratio"] == 1.0
+    finally:
+        b.stop()
+
+
+def test_bucket_rounding_groups_same_shape_only(engine, batcher):
+    short = batcher.submit([1, 2], max_new_tokens=8)  # (8, 8) class
+    long = batcher.submit(list(range(1, 13)), max_new_tokens=8)  # (16, 8)
+    short.wait(timeout=30.0)
+    long.wait(timeout=30.0)
+    assert short.shape == (8, 8)
+    assert long.shape == (16, 8)
+
+
+def test_queue_overflow_rejected(engine, fresh_registry):
+    b = MicroBatcher(engine, max_queue=3)  # not started: nothing drains
+    for i in range(3):
+        b.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(QueueFull, match="retry with backoff"):
+        b.submit([1, 2], max_new_tokens=2)
+    assert fresh_registry.counters["serve/rejected"] == 1.0
+    b.stop()  # pending requests are failed, not stranded
+
+
+def test_submit_validation(engine, batcher):
+    with pytest.raises(ValueError, match="empty prompt"):
+        batcher.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        batcher.submit([1], max_new_tokens=0)
+    with pytest.raises(ValueError, match="fits no serve bucket"):
+        batcher.submit([1], max_new_tokens=99)
+
+
+def test_wait_timeout_raises(engine):
+    b = MicroBatcher(engine)  # not started
+    req = b.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(TimeoutError, match="not decoded within"):
+        req.wait(timeout=0.05)
+    b.stop()
+
+
+def test_stopped_batcher_fails_pending(engine):
+    b = MicroBatcher(engine)  # not started
+    req = b.submit([1, 2], max_new_tokens=2)
+    b.stop()
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        req.wait(timeout=1.0)
+
+
+# --------------------------------------------------------------------- #
+# chaos-driven stall containment
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_hang_surfaces_as_watchdog_stall(engine, fresh_registry):
+    """serve_decode:hang wedges the decode phase; the serve supervisor
+    (owned by the batcher worker) must detect the stall — stack dump,
+    fault/stalls — and releasing the hang fails only that batch while
+    the loop keeps serving."""
+    exit_codes = []
+    sup = RunSupervisor(
+        stall_timeout=0.3,
+        stall_first_timeout=0.3,
+        stall_grace=10_000.0,
+        exit_fn=exit_codes.append,
+    )
+    chaos.configure("serve_decode:hang=60@1")
+    b = MicroBatcher(engine, max_wait_ms=5.0, run_supervisor=sup)
+    b.start()
+    try:
+        req = b.submit([1, 2, 3], max_new_tokens=2)
+        deadline = time.monotonic() + 15.0
+        while sup.stalls == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.stalls >= 1, "watchdog never flagged the hung decode"
+        assert sup.stalled_phase == "serve_decode"
+        assert fresh_registry.counters["fault/stalls"] >= 1.0
+        chaos.reset()  # releases the hang as ChaosHang in the worker
+        with pytest.raises(chaos.ChaosHang):
+            req.wait(timeout=15.0)
+        assert fresh_registry.counters["serve/request_errors"] >= 1.0
+        # the loop survived: a fresh request decodes normally
+        ok = b.submit([4, 5], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+        assert not exit_codes  # grace was huge: no escalation
+    finally:
+        chaos.reset()
+        b.stop()
+
+
+def test_chaos_exc_fails_batch_not_loop(engine, fresh_registry, batcher):
+    chaos.configure("serve_decode:exc@1")
+    try:
+        req = batcher.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(chaos.ChaosError):
+            req.wait(timeout=30.0)
+        ok = batcher.submit([3, 4], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+    finally:
+        chaos.reset()
+
+
+# --------------------------------------------------------------------- #
+# HTTP endpoint
+# --------------------------------------------------------------------- #
+
+
+def _post(port, payload, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=60
+    ) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def server(engine):
+    telemetry.start()
+    srv = InferenceServer(engine, port=0).start(warmup=True)
+    yield srv
+    srv.stop()
+
+
+def test_healthz(server):
+    status, body = _get(server.port, "/healthz")
+    assert status == 200
+    assert body["status"] == "ok" and body["warmed"]
+    assert [2, 8, 8] in body["buckets"]
+
+
+def test_generate_roundtrip(server):
+    status, body = _post(
+        server.port, {"prompt": "hello", "max_new_tokens": 4}
+    )
+    assert status == 200
+    assert isinstance(body["tokens"], list) and len(body["tokens"]) <= 4
+    assert isinstance(body["text"], str)
+    assert body["bucket"] == [8, 8]
+    assert body["latency_ms"] >= 0
+
+
+def test_generate_by_tokens_matches_prompt(server):
+    engine = server.engine
+    toks = engine.encode_prompt("abc")
+    s1, b1 = _post(server.port, {"prompt": "abc", "max_new_tokens": 6})
+    s2, b2 = _post(server.port, {"tokens": toks, "max_new_tokens": 6})
+    assert s1 == s2 == 200
+    assert b1["tokens"] == b2["tokens"]  # greedy: composition-independent
+
+
+def test_http_error_taxonomy(server):
+    # 400: bad JSON
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/generate",
+            data=b"{not json", method="POST",
+        )
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+    # 400: no prompt/tokens
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"wrong": 1})
+    assert e.value.code == 400
+    # 400: request exceeds every bucket
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"prompt": "x", "max_new_tokens": 10_000})
+    assert e.value.code == 400
+    # 404: unknown routes
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server.port, "/nope")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {}, path="/nope")
+    assert e.value.code == 404
+
+
+def test_chaos_request_exc_maps_to_500(server):
+    chaos.configure("serve_request:exc@1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": "x", "max_new_tokens": 2})
+        assert e.value.code == 500
+        assert "chaos" in json.loads(e.value.read())["error"]
+    finally:
+        chaos.reset()
+
+
+def test_queue_full_maps_to_429(server):
+    batcher = server.batcher
+    old = batcher.max_queue
+    batcher.max_queue = 0
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, {"prompt": "x", "max_new_tokens": 2})
+        assert e.value.code == 429
+    finally:
+        batcher.max_queue = old
+
+
+def test_metrics_dump_has_serve_family(server):
+    _post(server.port, {"prompt": "warm", "max_new_tokens": 2})
+    status, body = _get(server.port, "/metrics")
+    assert status == 200
+    counters, gauges = body["counters"], body["gauges"]
+    assert counters["serve/requests"] >= 1
+    assert counters["serve/batches"] >= 1
+    assert "serve/rejected" in counters  # predeclared even before firing
+    assert "serve/queue_depth" in gauges
+    assert "serve/batch_fill_ratio" in gauges
+    assert "serve/tokens_per_sec" in gauges
+    assert any(k.startswith("time/serve/decode_") for k in body["timings"])
+    assert "serve/request_latency" in body["timings"]
+    hist = body["timings"]["serve/request_latency"]
+    assert "p50_s" in hist and "p95_s" in hist
+
+
+# --------------------------------------------------------------------- #
+# checkpoint -> endpoint e2e (the acceptance scenario)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_checkpoint_to_endpoint_parity_e2e(tmp_path, seed):
+    """Train-side checkpoint in, HTTP endpoint out: >= 8 concurrent
+    mixed-length requests decode token-identically to a direct
+    ``generate()`` call at the same bucket, with zero steady-state
+    recompiles and the serve/* metric family in /metrics."""
+    from trlx_tpu.models.generation import generate
+    from trlx_tpu.utils.loading import get_model
+
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    trainer = get_model(cfg.model.model_type)(cfg)
+    ckpt = str(tmp_path / "ckpt")
+    trainer.save(ckpt)
+
+    registry = telemetry.start().registry
+    serve_cfg = ServeConfig(
+        buckets=[[8, 8, 8]], max_wait_ms=250.0, max_queue=64,
+        request_timeout=60.0,
+    )
+    # config=None: the architecture comes from the checkpoint's own
+    # embedded meta.json config — the self-describing-checkpoint path
+    engine = InferenceEngine.from_checkpoint(ckpt, serve=serve_cfg)
+    server = InferenceServer(engine, port=0).start(warmup=True)
+    try:
+        prompts = ["a", "bc", "def", "ghij", "klmno", "pqrstu",
+                   "vwxyz12", "34567890"]
+        rows = [engine.encode_prompt(p) for p in prompts]
+        assert sorted({len(r) for r in rows}) == list(range(1, 9))
+
+        results = [None] * len(prompts)
+        errors = []
+
+        def call(i):
+            try:
+                _, body = _post(
+                    server.port,
+                    {"prompt": prompts[i], "max_new_tokens": 8},
+                )
+                results[i] = body
+            except Exception as e:  # surfaces in the main thread below
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=call, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, f"request failures: {errors}"
+
+        # direct generate() at the same bucket: identical stacked batch
+        bucket = (8, 8, 8)
+        tokens, mask = engine.pad_batch(rows, bucket)
+        gen_cfg = engine._gen_base._replace(gen_size=8)
+        direct = jax.jit(
+            lambda b, e, lf, t, m, r: generate(
+                engine.spec, b, e, lf, t, m, r, gen_cfg,
+                compute_dtype=jnp.float32,
+            )
+        )(engine.blocks, engine.embed, engine.ln_f, tokens, mask,
+          jax.random.PRNGKey(seed))
+        for i in range(len(prompts)):
+            expect = engine.depad_row(direct, i, 8)
+            assert results[i]["tokens"] == expect, (
+                f"request {i} ({prompts[i]!r}) diverged from direct "
+                f"generate(): {results[i]['tokens']} vs {expect}"
+            )
+
+        # serving invariant: exactly one compile per warmed bucket and
+        # ZERO steady-state recompiles across all live traffic
+        _, metrics = _get(server.port, "/metrics")
+        assert metrics["counters"]["compile/recompiles"] == 0
+        assert registry.counters["compile/recompiles"] == 0.0
+        span = engine.span_name(bucket)
+        assert f"compile/{span}_first_s" in metrics["gauges"]
+        assert metrics["counters"]["serve/requests"] >= 8
+        assert metrics["counters"]["serve/generated_tokens"] > 0
+        assert metrics["gauges"].get("serve/model_gb", 0) > 0
+    finally:
+        server.stop()
+        telemetry.start()
+
+
+def test_from_checkpoint_without_embedded_config_raises(tmp_path):
+    from trlx_tpu.utils.checkpoint import save_components
+
+    save_components({"state": {"iter_count": 0}}, str(tmp_path / "c"))
+    with pytest.raises(ValueError, match="no embedded config"):
+        InferenceEngine.from_checkpoint(str(tmp_path / "c"))
+
+
+def test_from_checkpoint_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no committed checkpoint"):
+        InferenceEngine.from_checkpoint(str(tmp_path / "nope"))
+
+
+# --------------------------------------------------------------------- #
+# CLI surface
+# --------------------------------------------------------------------- #
+
+
+def test_cli_bucket_parsing():
+    from trlx_tpu.serve.__main__ import build_parser, parse_buckets
+
+    assert parse_buckets("8x32x16,4x8x8") == [[8, 32, 16], [4, 8, 8]]
+    with pytest.raises(ValueError, match="BATCHxPROMPTxGEN"):
+        parse_buckets("8x32")
+    args = build_parser().parse_args(
+        ["--checkpoint", "c", "--buckets", "2x8x8", "--port", "0",
+         "--max-wait-ms", "5", "--max-queue", "7"]
+    )
+    from trlx_tpu.serve.__main__ import serve_config_from_args
+
+    cfg = serve_config_from_args(args)
+    assert cfg.buckets == [[2, 8, 8]]
+    assert cfg.port == 0 and cfg.max_wait_ms == 5 and cfg.max_queue == 7
+
+
+def test_serve_config_roundtrip():
+    cfg = ServeConfig.from_dict(
+        {"buckets": [[2, 8, 8]], "max_wait_ms": 7, "unknown_key": 1}
+    )
+    assert cfg.buckets == [[2, 8, 8]] and cfg.max_wait_ms == 7
+
+
+def test_config_embeds_and_roundtrips():
+    """The trainers' checkpoint config component parses back into an
+    equivalent TRLConfig (the serve CLI's no-config path)."""
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    rebuilt = TRLConfig.from_dict(cfg.to_nested_dict())
+    assert rebuilt.model.__dict__ == cfg.model.__dict__
+    assert rebuilt.train.__dict__ == cfg.train.__dict__
+    assert rebuilt.method.__dict__ == cfg.method.__dict__
+    assert json.loads(json.dumps(cfg.to_nested_dict()))  # JSON-safe

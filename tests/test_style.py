@@ -97,26 +97,48 @@ def test_lint(path):
         # clock (supervisor/ — its timing IS the supervision mechanism
         # and surfaces as fault/* counters): ad-hoc time.time()/
         # perf_counter() deltas are exactly the opaque instrumentation
-        # the unified telemetry layer replaced (docs "Observability")
+        # the unified telemetry layer replaced (docs "Observability").
+        # Every other package — trlx_tpu/serve/ explicitly included, so
+        # the serving subsystem inherits the gate from day one — must
+        # source clocks from those modules (the batcher's flush-deadline
+        # clock is supervisor.monotonic).
         timing_allowed = (
             path == lib / "utils" / "__init__.py"
             or (lib / "telemetry") in path.parents
             or (lib / "supervisor") in path.parents
         )
         if not timing_allowed:
+            # names bound by `from time import ...` (the evasion the
+            # attribute check below would miss)
+            time_fns = ("time", "perf_counter", "monotonic")
+            from_time = set()
             for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and node.module == "time":
+                    for alias in node.names:
+                        if alias.name in time_fns:
+                            from_time.add(alias.asname or alias.name)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = None
                 if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in ("time", "perf_counter",
-                                           "monotonic")
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in time_fns
                     and isinstance(node.func.value, ast.Name)
                     and node.func.value.id == "time"
                 ):
+                    hit = f"time.{node.func.attr}"
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in from_time
+                ):
+                    hit = node.func.id
+                if hit:
                     problems.append(
-                        f"line {node.lineno}: ad-hoc time.{node.func.attr}"
-                        f"() timing — use trlx_tpu.telemetry.span()/"
-                        f"observe() (or utils.Clock) so the measurement "
+                        f"line {node.lineno}: ad-hoc {hit}() timing — "
+                        f"use trlx_tpu.telemetry.span()/observe() (or "
+                        f"utils.Clock / supervisor.monotonic for "
+                        f"control-flow deadlines) so the measurement "
                         f"reaches the metrics stream"
                     )
         for node in ast.walk(tree):

@@ -367,6 +367,19 @@ class TRLConfig:
             get_method(config["method"]["name"]).from_dict(config["method"]),
         )
 
+    def to_nested_dict(self) -> Dict[str, Any]:
+        """Round-trippable three-section dict: ``from_dict(to_nested_dict())``
+        rebuilds an equivalent config (method.name is a dataclass field,
+        so the method registry key survives). JSON-serializable — the
+        trainers embed it as the checkpoint's ``config`` component
+        (meta.json), which is how ``python -m trlx_tpu.serve`` rebuilds
+        the exact architecture/tokenizer/sampling without a config file."""
+        return {
+            "model": dict(self.model.__dict__),
+            "train": dict(self.train.__dict__),
+            "method": dict(self.method.__dict__),
+        }
+
     def to_dict(self) -> Dict[str, Any]:
         """Flat merged view of all three sections (the shape trackers log).
 

@@ -209,7 +209,29 @@ class GenerationConfig(NamedTuple):
         = min_length - prompt_len. The reference configs pin min_length ==
         max_length (configs/ppo_config.yml:48-49), which means fixed-length
         generation — translated as min_new_tokens == gen_size (eos fully
-        suppressed)."""
+        suppressed).
+
+        An explicit HF-style ``max_new_tokens`` (what serving clients
+        pass) overrides ``gen_size`` — the `gen_size` argument then acts
+        as the compiled ceiling (the trainer's configured length / the
+        serve bucket's gen extent), and exceeding it raises instead of
+        silently truncating or recompiling."""
+        max_new = gen_kwargs.get("max_new_tokens")
+        if max_new is not None:
+            max_new = int(max_new)
+            if max_new <= 0:
+                raise ValueError(
+                    f"gen_kwargs max_new_tokens={max_new} must be >= 1"
+                )
+            if max_new > gen_size:
+                raise ValueError(
+                    f"gen_kwargs max_new_tokens={max_new} exceeds the "
+                    f"compiled generation length (gen_size / serve bucket "
+                    f"gen extent) of {gen_size}; raise train.gen_size or "
+                    f"add a larger serve bucket instead of asking one "
+                    f"program for more tokens than it was compiled for"
+                )
+            gen_size = max_new
         min_len = int(gen_kwargs.get("min_length", 0) or 0)
         max_len = int(gen_kwargs.get("max_length", 0) or 0)
         if min_len and min_len >= max_len:

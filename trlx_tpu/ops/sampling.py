@@ -96,11 +96,20 @@ def warp_logits(logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
 def sample_token(
     rng: jax.Array, logits: jnp.ndarray, params: SamplingParams
 ) -> jnp.ndarray:
-    """Draw next tokens [B] from warped logits [B, V] (or argmax if greedy)."""
+    """Draw next tokens [B] from warped logits [B, V] (or argmax if greedy).
+
+    Greedy decode (`do_sample=False`) skips the warpers entirely: every
+    one is argmax-invariant — temperature divides by a positive scalar
+    (max(t, 1e-6)), and top-k / top-p only mask entries BELOW the top-1
+    (both always keep it). Warping anyway paid a full-vocab `lax.top_k`
+    (and under small top_p a sort) per decode step for an identical
+    argmax — pure waste on the serving path, where greedy is the default
+    reproducibility mode (regression test: test_generation.py
+    test_greedy_skips_warps_unchanged)."""
+    if not params.do_sample:
+        return jnp.argmax(logits, axis=-1)
     warped = warp_logits(logits, params)
-    if params.do_sample:
-        return jax.random.categorical(rng, warped, axis=-1)
-    return jnp.argmax(warped, axis=-1)
+    return jax.random.categorical(rng, warped, axis=-1)
 
 
 def advantage_shifted_logits(

@@ -1,0 +1,41 @@
+"""Inference serving: checkpoint-to-endpoint engine for trained policies.
+
+The first subsystem on the inference side of the ROADMAP's north star
+("serves heavy traffic"): everything before this package hardened the
+*training* workload; this one consumes its artifacts. A checkpoint
+written by the trainers (trlx_tpu.utils.checkpoint) becomes a long-lived
+local HTTP endpoint in one command::
+
+    python -m trlx_tpu.serve --checkpoint ckpts/ppo_sentiments
+
+Three layers (docs/source/serving.rst):
+
+- :class:`InferenceEngine` (serve.engine) — restores the policy (params
+  only; ref branch / value head / optimizer state stripped), precompiles
+  the jitted KV-cache ``generate()`` over a static (batch, prompt_len,
+  gen_len) **bucket lattice** through ``utils.aotjit`` so steady-state
+  requests never recompile (``compile/recompiles == 0`` is the serving
+  invariant);
+- :class:`MicroBatcher` (serve.batcher) — Orca-lineage dynamic
+  micro-batching: requests round up to a compiled shape class and
+  coalesce until the bucket fills or ``max_wait_ms`` passes, with
+  ``max_queue`` admission control;
+- :class:`InferenceServer` (serve.server) — stdlib ThreadingHTTPServer
+  JSON API (``POST /generate``, ``GET /healthz``, ``GET /metrics``)
+  wired into the telemetry registry, the supervisor watchdog
+  (``serve_decode`` phase + heartbeat per batch), bounded request
+  handling, and the ``serve_decode`` / ``serve_request`` chaos seams.
+"""
+
+from trlx_tpu.serve.batcher import MicroBatcher, QueueFull, Request  # noqa: F401
+from trlx_tpu.serve.engine import InferenceEngine, ServeConfig  # noqa: F401
+from trlx_tpu.serve.server import InferenceServer  # noqa: F401
+
+__all__ = [
+    "InferenceEngine",
+    "InferenceServer",
+    "MicroBatcher",
+    "QueueFull",
+    "Request",
+    "ServeConfig",
+]

@@ -1,0 +1,100 @@
+"""``python -m trlx_tpu.serve`` — checkpoint dir in, HTTP endpoint out.
+
+The config (architecture, tokenizer, sampling) defaults to the one the
+trainer embedded in the checkpoint's meta.json, so the minimal launch is
+just ``--checkpoint``; ``--config`` overrides it, and the ``serve:``
+section of that YAML (or the flags below, which win) sizes the bucket
+lattice and the batcher. See docs/source/serving.rst.
+"""
+
+import argparse
+import sys
+
+import yaml
+
+from trlx_tpu.serve.engine import InferenceEngine, ServeConfig
+from trlx_tpu.serve.server import InferenceServer
+
+
+def parse_buckets(spec: str):
+    """"8x32x16,16x64x32" -> [[8, 32, 16], [16, 64, 32]] (BxPxG)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = part.lower().split("x")
+        if len(dims) != 3:
+            raise ValueError(
+                f"bucket '{part}' is not BATCHxPROMPTxGEN (e.g. 8x32x16)"
+            )
+        out.append([int(d) for d in dims])
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.serve",
+        description="Serve a trained trlx_tpu policy checkpoint over HTTP.",
+    )
+    p.add_argument("--checkpoint", required=True,
+                   help="checkpoint dir, or a run dir of step_<N> dirs "
+                        "(the newest committed one is used)")
+    p.add_argument("--config", default=None,
+                   help="training YAML; default: the config embedded in "
+                        "the checkpoint's meta.json")
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated BATCHxPROMPTxGEN lattice, e.g. "
+                        "'8x32x16,16x64x32' (overrides the serve: section)")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="micro-batch coalescing deadline")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission-control queue bound (429 past it)")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="per-request walltime bound (503 past it)")
+    p.add_argument("--stall-timeout", type=float, default=None,
+                   help="watchdog budget per decoded batch (0 = off)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip lattice precompilation at startup (first "
+                        "request per bucket then pays the compile)")
+    return p
+
+
+def serve_config_from_args(args) -> ServeConfig:
+    """The serve: YAML section (when --config names a file carrying one)
+    with CLI flags layered on top."""
+    section = {}
+    if args.config:
+        with open(args.config) as f:
+            section = (yaml.safe_load(f) or {}).get("serve") or {}
+    cfg = ServeConfig.from_dict(section)
+    if args.buckets is not None:
+        cfg.buckets = parse_buckets(args.buckets)
+    for flag, attr in (("host", "host"), ("port", "port"),
+                       ("max_wait_ms", "max_wait_ms"),
+                       ("max_queue", "max_queue"),
+                       ("request_timeout", "request_timeout"),
+                       ("stall_timeout", "stall_timeout")):
+        value = getattr(args, flag)
+        if value is not None:
+            setattr(cfg, attr, value)
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    serve_cfg = serve_config_from_args(args)
+    engine = InferenceEngine.from_checkpoint(
+        args.checkpoint, config=args.config, serve=serve_cfg
+    )
+    print(f"[trlx_tpu.serve] restored policy from "
+          f"{engine.checkpoint_path}", file=sys.stderr, flush=True)
+    server = InferenceServer(engine).start(warmup=not args.no_warmup)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

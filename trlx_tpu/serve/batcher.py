@@ -1,0 +1,258 @@
+"""Dynamic micro-batcher: request coalescing into bucket-shaped decodes.
+
+Orca-style continuous batching (Yu et al., OSDI'22) adapted to a
+static-shape XLA decode: instead of admitting requests into a running
+program (impossible — shapes are compiled in), requests queue, round UP
+to a compiled ``(prompt_len, gen_len)`` shape class (the *bucket
+rounding* rule), and the worker flushes one bucket-shaped batch when
+either
+
+- enough same-shape requests queue to fill a compiled batch extent, or
+- the oldest queued request has waited ``max_wait_ms``
+
+— whichever comes first (latency-bounded coalescing). The batch extent
+is chosen at flush time: the smallest compiled batch size holding every
+ready same-shape request, so light traffic decodes in small programs and
+heavy traffic fills the big ones. Short batches are padded with filler
+rows (never read back); per-request completions are de-padded and
+truncated to each request's own ``max_new_tokens``.
+
+Admission control: :meth:`MicroBatcher.submit` raises :class:`QueueFull`
+once ``max_queue`` requests are pending — the server maps it to HTTP 429
+so overload degrades into fast rejections, not unbounded latency.
+
+Containment: the worker thread enters the serve supervisor (when
+configured) and marks each decode as the ``serve_decode`` phase with a
+heartbeat per decoded batch — a hung decode dumps all-thread stacks and
+counts ``fault/stalls`` instead of leaving a silently dead port. The
+``serve_decode`` chaos seam fires inside that phase so the stall path is
+CPU-testable (trlx_tpu.supervisor.chaos).
+
+Metrics (trlx_tpu.telemetry): ``serve/queue_depth`` gauge,
+``serve/batch_fill_ratio`` gauge, ``serve/request_latency`` histogram
+(p50/p95), ``serve/tokens_per_sec`` gauge, and the
+``serve/requests|responses|batches|rejected|request_errors|generated_tokens``
+counters.
+"""
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+from trlx_tpu import supervisor, telemetry
+from trlx_tpu.supervisor import chaos, monotonic
+
+
+class QueueFull(RuntimeError):
+    """Admission control rejection: the serve queue is at ``max_queue``.
+    Clients should back off and retry (HTTP 429)."""
+
+
+class Request:
+    """One queued generation request and its completion slot."""
+
+    __slots__ = ("tokens", "max_new_tokens", "seed", "shape",
+                 "enqueued_at", "done", "result", "error", "latency_s")
+
+    def __init__(self, tokens: List[int], max_new_tokens: int,
+                 shape, seed: Optional[int] = None):
+        self.tokens = tokens
+        self.max_new_tokens = max_new_tokens
+        self.seed = seed
+        self.shape = shape  # (prompt_len, gen_len) class
+        self.enqueued_at = monotonic()
+        self.done = threading.Event()
+        self.result: Optional[List[int]] = None
+        self.error: Optional[BaseException] = None
+        self.latency_s: float = 0.0
+
+    def wait(self, timeout: Optional[float] = None) -> "Request":
+        """Block until decoded; re-raises the worker-side error if the
+        batch failed, raises TimeoutError if `timeout` expires first."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"request not decoded within {timeout:.3g}s (queue "
+                f"backlog or a stalled decode — check serve/queue_depth "
+                f"and fault/stalls)"
+            )
+        if self.error is not None:
+            raise self.error
+        return self
+
+
+class MicroBatcher:
+    """The engine's single decode driver: one worker thread, one device
+    program in flight at a time."""
+
+    def __init__(self, engine, max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None, run_supervisor=None):
+        self.engine = engine
+        cfg = engine.serve
+        self.max_wait_s = (
+            cfg.max_wait_ms if max_wait_ms is None else max_wait_ms
+        ) / 1000.0
+        self.max_queue = cfg.max_queue if max_queue is None else max_queue
+        #: optional trlx_tpu.supervisor.RunSupervisor — ENTERED BY THE
+        #: WORKER THREAD so its phase stack describes the decode loop
+        self.run_supervisor = run_supervisor
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._batch_counter = 0
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="trlx-serve-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # fail pending requests loudly rather than stranding waiters
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req.error = RuntimeError("serve batcher stopped")
+            req.done.set()
+
+    # -- submission ------------------------------------------------------ #
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, tokens: List[int], max_new_tokens: Optional[int] = None,
+               seed: Optional[int] = None) -> Request:
+        """Enqueue one request (bucket-rounded); raises ValueError when
+        no lattice bucket fits, QueueFull past ``max_queue``."""
+        if not tokens:
+            raise ValueError("empty prompt: at least one token is required")
+        if max_new_tokens is None:
+            max_new_tokens = self.engine.default_max_new_tokens()
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
+        shape = self.engine.pick_shape(len(tokens), max_new_tokens)
+        req = Request(list(tokens), max_new_tokens, shape, seed=seed)
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                telemetry.inc("serve/rejected")
+                raise QueueFull(
+                    f"serve queue is full ({self.max_queue} pending); "
+                    f"retry with backoff (serve.max_queue bounds queueing "
+                    f"delay — raise it to trade latency for acceptance)"
+                )
+            self._queue.append(req)
+            telemetry.inc("serve/requests")
+            telemetry.set_gauge("serve/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    # -- worker ---------------------------------------------------------- #
+
+    def _take_batch(self) -> List[Request]:
+        """Block until a flushable batch exists: the head request's shape
+        class either fills its largest compiled batch extent or ages past
+        ``max_wait_ms``. Returns [] only on shutdown."""
+        with self._cond:
+            while not self._stop.is_set():
+                if not self._queue:
+                    self._cond.wait(timeout=0.5)
+                    continue
+                head = self._queue[0]
+                shape = head.shape
+                ready = [r for r in self._queue if r.shape == shape]
+                sizes = self.engine.batch_sizes_for(shape)
+                deadline = head.enqueued_at + self.max_wait_s
+                now = monotonic()
+                if len(ready) < sizes[-1] and now < deadline:
+                    self._cond.wait(timeout=deadline - now)
+                    continue
+                # smallest compiled extent holding every ready request;
+                # overfull queues flush the largest and leave the rest
+                take_cap = next(
+                    (b for b in sizes if b >= len(ready)), sizes[-1]
+                )
+                batch = ready[:take_cap]
+                for r in batch:
+                    self._queue.remove(r)
+                telemetry.set_gauge("serve/queue_depth", len(self._queue))
+                return batch
+            return []
+
+    def _flush(self, batch: List[Request]) -> None:
+        shape = batch[0].shape
+        sizes = self.engine.batch_sizes_for(shape)
+        B = next(b for b in sizes if b >= len(batch))
+        bucket = (B, shape[0], shape[1])
+        # batch seed: an explicit request seed wins (single-request
+        # batches are then exactly reproducible); otherwise a
+        # deterministic per-batch counter off serve.seed
+        seeds = [r.seed for r in batch if r.seed is not None]
+        seed = seeds[0] if seeds else (
+            self.engine.serve.seed + self._batch_counter
+        )
+        self._batch_counter += 1
+        tokens, mask = self.engine.pad_batch(
+            [r.tokens for r in batch], bucket
+        )
+        with supervisor.phase("serve_decode"):
+            chaos.maybe_inject("serve_decode")
+            out = self.engine.decode(bucket, tokens, mask, seed=seed)
+            # heartbeat per decoded batch: resets the watchdog budget so
+            # only a batch that HANGS (not a busy stream of them) stalls
+            supervisor.beat()
+        done_at = monotonic()
+        gen_total = 0
+        for i, req in enumerate(batch):
+            req.result = self.engine.depad_row(out, i, req.max_new_tokens)
+            gen_total += len(req.result)
+            req.latency_s = done_at - req.enqueued_at
+            telemetry.observe("serve/request_latency", req.latency_s)
+            req.done.set()
+        telemetry.inc("serve/responses", len(batch))
+        telemetry.inc("serve/batches")
+        telemetry.inc("serve/generated_tokens", gen_total)
+        telemetry.set_gauge("serve/batch_fill_ratio", len(batch) / B)
+        tel = telemetry.current()
+        if tel is not None:
+            hist = tel.registry.hists.get(
+                f"time/{self.engine.span_name(bucket)}"
+            )
+            if hist is not None and hist.last > 0:
+                telemetry.set_gauge(
+                    "serve/tokens_per_sec", gen_total / hist.last
+                )
+
+    def _run(self) -> None:
+        sup_cm = self.run_supervisor
+        if sup_cm is None:
+            import contextlib
+
+            sup_cm = contextlib.nullcontext()
+        with sup_cm:
+            while not self._stop.is_set():
+                batch = self._take_batch()
+                if not batch:
+                    continue
+                try:
+                    self._flush(batch)
+                except Exception as e:
+                    # one poisoned batch must not kill the serving loop:
+                    # fail ITS requests, count it, keep draining
+                    telemetry.inc("serve/request_errors", len(batch))
+                    for req in batch:
+                        req.error = e
+                        req.done.set()

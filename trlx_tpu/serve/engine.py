@@ -1,0 +1,466 @@
+"""Checkpoint-to-endpoint inference engine with bucketed AOT decode.
+
+The training side produces checkpoints (trlx_tpu.utils.checkpoint) and an
+engine-grade jitted KV-cache decode (trlx_tpu.models.generation) — but
+until this module the only consumer of either was the learn loop itself.
+:class:`InferenceEngine` closes the train->serve gap:
+
+- **restore**: loads the policy from a checkpoint dir or a run dir
+  (``find_latest_checkpoint`` resolves the newest committed ``step_<N>``),
+  reading the architecture/config from the checkpoint's own ``meta.json``
+  ``config`` component when none is passed (trainers embed it at save).
+  Only the ``params`` component is restored — the optimizer state never
+  leaves disk.
+- **strip**: serving needs the live policy branch only. The restored tree
+  is reduced to (trunk blocks + trainable top blocks, embed + lm_head,
+  ln_f) via the policy's own decode helpers; the reference branch and the
+  value head are dropped, so steady-state HBM holds one policy, not the
+  training triple.
+- **bucket lattice**: decode shapes are static under XLA, so the engine
+  precompiles ``generate()`` over a small lattice of
+  ``(batch, prompt_len, gen_len)`` buckets — each bucket gets its OWN
+  ``aot_jit`` wrapper (its own executable cache), so warming bucket N+1
+  is a first compile, not a steady-state miss, and ``compile/recompiles``
+  staying 0 is the serving invariant it already is for training.
+  :meth:`warmup` compiles every bucket up front; per-bucket first-call
+  latencies land apart from steady-state timings through the telemetry
+  tracer's existing first-call separation
+  (``compile/serve/decode_bBpPgG_first_s`` vs ``time/serve/decode_*``).
+
+Requests are shaped into buckets by :class:`trlx_tpu.serve.batcher`;
+the HTTP surface lives in :class:`trlx_tpu.serve.server`.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import filter_known_fields
+
+Bucket = Tuple[int, int, int]  # (batch, prompt_len, gen_len)
+
+#: default lattice for tiny/dev models; production lattices come from the
+#: YAML ``serve:`` section or --buckets (docs/source/serving.rst has the
+#: sizing guide)
+_DEFAULT_BUCKETS = ((4, 32, 32), (8, 64, 64))
+
+
+@dataclass
+class ServeConfig:
+    """The ``serve:`` YAML section / CLI knobs (all host-side).
+
+    :param buckets: the (batch, prompt_len, gen_len) lattice to
+        precompile. Requests round UP to the smallest (prompt_len,
+        gen_len) shape class that fits; the batch extent is chosen at
+        flush time from the same-shape queue population.
+    :param max_wait_ms: micro-batcher deadline — a batch is flushed when
+        the bucket's batch size fills OR the oldest queued request has
+        waited this long, whichever comes first.
+    :param max_queue: admission control — ``submit`` rejects once this
+        many requests are queued (the client sees HTTP 429).
+    :param request_timeout: bound on one request's queue+decode walltime;
+        a breach raises SeamTimeout (HTTP 503) instead of holding the
+        connection forever.
+    :param stall_timeout: serve-side watchdog budget for one decoded
+        batch (trlx_tpu.supervisor); a hung decode dumps all-thread
+        stacks and counts ``fault/stalls`` instead of leaving a silently
+        dead port. 0 disables.
+    :param host / port: bind address for the HTTP endpoint.
+    :param seed: base PRNG seed for sampling batches (each decoded batch
+        folds in a counter; greedy decode ignores it).
+    """
+
+    buckets: List[List[int]] = field(
+        default_factory=lambda: [list(b) for b in _DEFAULT_BUCKETS]
+    )
+    max_wait_ms: float = 20.0
+    max_queue: int = 256
+    request_timeout: float = 120.0
+    stall_timeout: float = 0.0
+    host: str = "127.0.0.1"
+    port: int = 8080
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
+        return cls(**filter_known_fields(cls, config or {}))
+
+
+def _normalize_buckets(buckets) -> Tuple[Bucket, ...]:
+    out = []
+    for b in buckets:
+        t = tuple(int(x) for x in b)
+        if len(t) != 3 or any(x <= 0 for x in t):
+            raise ValueError(
+                f"serve bucket {b!r} is not a positive "
+                f"(batch, prompt_len, gen_len) triple"
+            )
+        out.append(t)
+    if not out:
+        raise ValueError("serve.buckets must name at least one bucket")
+    # sort by shape class then batch: pick_bucket scans smallest-first
+    return tuple(sorted(set(out), key=lambda t: (t[1], t[2], t[0])))
+
+
+class InferenceEngine:
+    """A restored policy + its precompiled decode bucket lattice.
+
+    Thread-safety: :meth:`decode` serializes dispatches under a lock —
+    one device program runs at a time (the micro-batcher is the intended
+    single caller; the lock makes direct multi-threaded use safe rather
+    than fast).
+    """
+
+    def __init__(self, config: TRLConfig, serve: Optional[ServeConfig] = None,
+                 params: Optional[Dict] = None, init: bool = True):
+        """Build from an in-memory param tree (``params``) — the
+        checkpoint path is :meth:`from_checkpoint`. ``params`` defaults
+        to a fresh policy init (useful only for tests/dev); ``init=False``
+        defers weight installation entirely (the checkpoint path installs
+        the restored tree instead of paying a throwaway random init)."""
+        import jax.numpy as jnp
+
+        from trlx_tpu import telemetry
+        from trlx_tpu.data.method_configs import PPOConfig
+        from trlx_tpu.models.generation import GenerationConfig
+        from trlx_tpu.models.policy import HydraPolicy
+        from trlx_tpu.ops.sampling import SamplingParams
+        from trlx_tpu.utils.tokenizer import load_tokenizer
+
+        if not isinstance(config.method, PPOConfig):
+            raise NotImplementedError(
+                f"the inference engine serves hydra (PPO) policies; this "
+                f"config's method is '{config.method.name}'. ILQL "
+                f"checkpoints carry Q/V heads and a different param "
+                f"layout — serve support for them is a separate policy "
+                f"adapter."
+            )
+        # a serve process owns a telemetry session even without a trainer
+        # (/metrics reads the active session's summary); a session an
+        # embedding trainer already started is reused, not clobbered
+        if telemetry.current() is None:
+            telemetry.start()
+        self.config = config
+        self.serve = serve or ServeConfig()
+        self.buckets = _normalize_buckets(self.serve.buckets)
+        self.tokenizer = load_tokenizer(config.model.tokenizer_path)
+
+        spec, trunk = self._resolve_spec_and_trunk(config)
+        for b, p, g in self.buckets:
+            if p + g > spec.n_positions:
+                raise ValueError(
+                    f"serve bucket (batch={b}, prompt={p}, gen={g}) needs "
+                    f"{p + g} positions but the model has n_positions="
+                    f"{spec.n_positions}"
+                )
+        self.spec = spec
+        self._compute_dtype = {"float32": jnp.float32,
+                               "bfloat16": jnp.bfloat16,
+                               "float16": jnp.float16}[
+                                   config.model.compute_dtype]
+        self.policy = HydraPolicy(
+            spec=spec,
+            num_layers_unfrozen=config.model.num_layers_unfrozen,
+            compute_dtype=self._compute_dtype,
+        )
+        self._trunk = trunk
+        self.blocks = self.embed = self.ln_f = None
+        if params is not None:
+            self._install_params(params)
+        elif init:
+            self._install_params(self._init_params())
+
+        eos = getattr(self.tokenizer, "eos_token_id", -1)
+        pad = getattr(self.tokenizer, "pad_token_id", 0) or 0
+        gk = dict(config.method.gen_kwargs or {})
+        # serving semantics: stop at eos (min_new_tokens=0) — unlike the
+        # trainers' fixed-length translation of min_length==max_length
+        self._gen_base = GenerationConfig(
+            gen_size=1,  # per-bucket _replace below
+            sampling=SamplingParams(
+                temperature=float(gk.get("temperature", 1.0)),
+                top_k=int(gk.get("top_k", 0) or 0),
+                top_p=float(gk.get("top_p", 1.0)),
+                do_sample=bool(gk.get("do_sample", True)),
+            ),
+            eos_token_id=eos if eos is not None else -1,
+            pad_token_id=pad,
+            min_new_tokens=0,
+        )
+        self.pad_token_id = pad
+        self._decode_fns = {}  # bucket -> aot_jit'd generate closure
+        self._lock = None  # created lazily (threading import kept local)
+        self.warmed = False
+
+    # -- construction --------------------------------------------------- #
+
+    @staticmethod
+    def _resolve_spec_and_trunk(config: TRLConfig):
+        """(spec, pretrained trunk | None) — mirrors the trainers'
+        `_load_or_spec`: an explicit model_spec wins (offline-safe);
+        otherwise the HF import supplies both spec and init weights
+        (which the checkpoint restore then overwrites)."""
+        if config.model.model_spec is not None:
+            return config.model.resolve_spec(), None
+        from trlx_tpu.models.hf_import import load_trunk_from_hf
+
+        try:
+            spec, embed, blocks, ln_f = load_trunk_from_hf(
+                config.model.model_path
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"could not resolve the model architecture for serving: "
+                f"pretrained load of '{config.model.model_path}' failed "
+                f"({e!r}) and the config has no model.model_spec. Serve "
+                f"from a config whose model section matches the "
+                f"checkpoint's (the checkpoint's own meta.json 'config' "
+                f"component has it for checkpoints saved by this "
+                f"framework)."
+            ) from e
+        return spec, (embed, blocks, ln_f)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: str, config=None,
+                        serve: Optional[ServeConfig] = None,
+                        ) -> "InferenceEngine":
+        """Load a policy from ``checkpoint`` (a committed checkpoint dir,
+        or a run dir whose newest valid ``step_<N>`` is used).
+
+        ``config`` may be a TRLConfig, a YAML path, or None — None reads
+        the ``config`` component the trainers embed in the checkpoint's
+        meta.json, so ``python -m trlx_tpu.serve --checkpoint <dir>``
+        needs nothing else. Only the ``params`` component is restored;
+        opt_state/ref/value-head training baggage is stripped (module
+        docstring)."""
+        import json
+        import os
+
+        from trlx_tpu.utils.checkpoint import (
+            META_NAME,
+            find_latest_checkpoint,
+            is_valid_checkpoint,
+            restore_components,
+        )
+
+        resolved = checkpoint if is_valid_checkpoint(checkpoint) \
+            else find_latest_checkpoint(checkpoint)
+        if resolved is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint at '{checkpoint}' (expected a "
+                f"checkpoint dir with '{META_NAME}', or a run dir of "
+                f"'step_<N>' checkpoints)"
+            )
+        if config is None:
+            with open(os.path.join(resolved, META_NAME)) as f:
+                meta = json.load(f)
+            if "config" not in meta:
+                raise ValueError(
+                    f"checkpoint '{resolved}' carries no embedded config "
+                    f"(saved by a pre-serving version?); pass the training "
+                    f"config explicitly (--config <yml> on the CLI)."
+                )
+            config = TRLConfig.from_dict(meta["config"])
+        elif isinstance(config, str):
+            config = TRLConfig.load_yaml(config)
+
+        engine = cls(config, serve=serve, init=False)
+        restored = restore_components(
+            {"params": engine._init_params()}, resolved
+        )
+        engine._install_params(restored["params"])
+        engine.checkpoint_path = resolved
+        return engine
+
+    def _init_params(self) -> Dict:
+        """A full-structure hydra param tree — the checkpoint-restore
+        template (and the dev-mode weights). Transient by design: the
+        engine never retains it; only the decode views survive."""
+        import jax
+
+        if self._trunk is not None:
+            from trlx_tpu.models.hf_import import hydra_params_from_trunk
+
+            return hydra_params_from_trunk(
+                self.policy, *self._trunk, jax.random.PRNGKey(0)
+            )
+        return self.policy.init(jax.random.PRNGKey(0))
+
+    def _install_params(self, params: Dict) -> None:
+        """Keep only what decode reads: (trunk, trainable-top) block
+        segments, embed (+lm_head), ln_f. The full tree is NOT retained —
+        once the caller's reference drops, the reference branch and the
+        value head are garbage (opt_state was never restored at all), so
+        steady-state memory holds one serving policy, not the training
+        triple."""
+        from trlx_tpu import telemetry
+        from trlx_tpu.utils import tree_bytes
+
+        self.blocks = self.policy.all_blocks(params)
+        self.embed, self.ln_f = self.policy.head_params_for_decode(params)
+        kept = tree_bytes((self.blocks, self.embed, self.ln_f))
+        total = tree_bytes(params)
+        telemetry.set_gauge("serve/model_gb", kept / 2**30)
+        telemetry.set_gauge(
+            "serve/stripped_gb", max(total - kept, 0) / 2**30
+        )
+        self._decode_fns = {}  # shapes unchanged but weights swapped
+        self.warmed = False
+
+    # -- bucket lattice -------------------------------------------------- #
+
+    def shape_classes(self) -> Tuple[Tuple[int, int], ...]:
+        """Distinct (prompt_len, gen_len) classes, smallest first."""
+        seen = []
+        for _, p, g in self.buckets:
+            if (p, g) not in seen:
+                seen.append((p, g))
+        return tuple(seen)
+
+    def pick_shape(self, prompt_len: int,
+                   max_new_tokens: int) -> Tuple[int, int]:
+        """Smallest (prompt_len, gen_len) shape class fitting the
+        request — the bucket-rounding rule. Raises ValueError (HTTP 400)
+        when nothing fits."""
+        for p, g in self.shape_classes():
+            if prompt_len <= p and max_new_tokens <= g:
+                return (p, g)
+        raise ValueError(
+            f"request (prompt_len={prompt_len}, max_new_tokens="
+            f"{max_new_tokens}) fits no serve bucket; lattice shape "
+            f"classes (prompt, gen): {list(self.shape_classes())}"
+        )
+
+    def batch_sizes_for(self, shape: Tuple[int, int]) -> Tuple[int, ...]:
+        """Ascending batch extents compiled for one shape class."""
+        return tuple(sorted(
+            b for b, p, g in self.buckets if (p, g) == shape
+        ))
+
+    def max_new_tokens_cap(self) -> int:
+        return max(g for _, _, g in self.buckets)
+
+    def default_max_new_tokens(self) -> int:
+        return min(g for _, _, g in self.buckets)
+
+    # -- decode ---------------------------------------------------------- #
+
+    def _decode_fn(self, bucket: Bucket):
+        """The bucket's compiled generate closure — one ``aot_jit``
+        instance PER bucket so each owns its executable cache: warming a
+        new bucket is a first compile, never a steady-state miss, and any
+        later ``compile/recompiles`` increment is a real drift signal."""
+        fn = self._decode_fns.get(bucket)
+        if fn is None:
+            from trlx_tpu.models.generation import decide_unroll, generate
+            from trlx_tpu.utils.aotjit import aot_jit
+
+            B, P, G = bucket
+            cfg = self._gen_base._replace(gen_size=G)
+            spec = self.spec
+            compute = self._compute_dtype
+            unroll = decide_unroll(spec, self.blocks, B, P + G)
+
+            def run(blocks, embed, ln_f, tokens, mask, rng):
+                return generate(
+                    spec, blocks, embed, ln_f, tokens, mask, rng, cfg,
+                    compute_dtype=compute, unroll_layers=unroll,
+                )
+
+            fn = self._decode_fns[bucket] = aot_jit(run)
+        return fn
+
+    def span_name(self, bucket: Bucket) -> str:
+        B, P, G = bucket
+        return f"serve/decode_b{B}p{P}g{G}"
+
+    def decode(self, bucket: Bucket, tokens: np.ndarray, mask: np.ndarray,
+               seed: int = 0):
+        """Run one bucket-shaped batch: tokens/mask are left-padded
+        ``[B, P]`` int32; returns the GenerationOutput as host numpy
+        (blocking — the micro-batcher's flush IS the dispatch boundary).
+        """
+        import threading
+
+        import jax
+
+        from trlx_tpu import telemetry
+
+        B, P, G = bucket
+        if tokens.shape != (B, P):
+            raise ValueError(
+                f"decode batch shape {tokens.shape} does not match "
+                f"bucket (batch={B}, prompt={P})"
+            )
+        if self._lock is None:
+            self._lock = threading.Lock()
+        fn = self._decode_fn(bucket)
+        rng = jax.random.PRNGKey(seed)
+        with self._lock, telemetry.span(self.span_name(bucket)):
+            out = fn(
+                self.blocks, self.embed, self.ln_f,
+                np.ascontiguousarray(tokens, np.int32),
+                np.ascontiguousarray(mask, np.int32), rng,
+            )
+            out = jax.device_get(out)
+        return out
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every lattice bucket up front so no live request pays
+        tracing + XLA compilation. Returns {bucket span name: first-call
+        seconds} (also in telemetry as ``compile/<span>_first_s`` gauges
+        via the tracer's first-call separation)."""
+        from trlx_tpu import telemetry
+
+        latencies = {}
+        for bucket in self.buckets:
+            B, P, G = bucket
+            tokens = np.full((B, P), self.pad_token_id, np.int32)
+            tokens[:, -1] = 0
+            mask = np.zeros((B, P), np.int32)
+            mask[:, -1] = 1
+            self.decode(bucket, tokens, mask, seed=0)
+            tel = telemetry.current()
+            if tel is not None:
+                hist = tel.registry.hists.get(
+                    f"time/{self.span_name(bucket)}"
+                )
+                if hist is not None and hist.first is not None:
+                    latencies[self.span_name(bucket)] = hist.first
+        self.warmed = True
+        telemetry.set_gauge("serve/buckets_warmed", len(self.buckets))
+        return latencies
+
+    # -- request shaping -------------------------------------------------- #
+
+    def encode_prompt(self, prompt: str) -> List[int]:
+        ids = self.tokenizer.encode(prompt)
+        # HF fast tokenizers return lists; keep plain ints either way
+        return [int(t) for t in ids]
+
+    def pad_batch(self, rows: Sequence[Sequence[int]], bucket: Bucket
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Left-pad token rows into the bucket's [B, P] shape; rows short
+        of B are filled by repeating the first row (the filler decodes
+        garbage that is simply never read back)."""
+        B, P, _ = bucket
+        if len(rows) > B or not rows:
+            raise ValueError(f"{len(rows)} rows for a batch-{B} bucket")
+        tokens = np.full((B, P), self.pad_token_id, np.int32)
+        mask = np.zeros((B, P), np.int32)
+        for i in range(B):
+            row = rows[i] if i < len(rows) else rows[0]
+            row = list(row)[-P:]
+            tokens[i, P - len(row):] = row
+            mask[i, P - len(row):] = 1
+        return tokens, mask
+
+    def depad_row(self, out, row: int, max_new_tokens: int) -> List[int]:
+        """One request's completion from a batched GenerationOutput:
+        the row's generated tokens, truncated to its own max_new_tokens,
+        cut where gen_mask ends (eos included, pads after excluded)."""
+        gen = np.asarray(out.gen_tokens[row])[:max_new_tokens]
+        gmask = np.asarray(out.gen_mask[row])[:max_new_tokens]
+        return [int(t) for t, m in zip(gen, gmask) if m]

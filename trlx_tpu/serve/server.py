@@ -1,0 +1,225 @@
+"""Stdlib HTTP endpoint over the inference engine + micro-batcher.
+
+``ThreadingHTTPServer`` + JSON — no new dependencies, matching the rest
+of the codebase's stdlib-only host layer. Three routes:
+
+- ``POST /generate`` — body ``{"prompt": str | "tokens": [int],
+  "max_new_tokens": int?, "seed": int?}``; returns the completion with
+  its de-padded tokens, the bucket shape class that served it, and the
+  measured queue+decode latency. Errors are typed: 400 (bad request / no
+  bucket fits), 429 (queue full — admission control), 503 (request
+  timed out past ``serve.request_timeout``), 500 (decode/chaos failure).
+- ``GET /healthz`` — liveness + lattice + queue depth. A process whose
+  decode thread is wedged still answers (HTTP is a different thread) —
+  which is exactly why the batcher runs under the supervisor watchdog:
+  the hang surfaces as a stack-dumping stall (``fault/stalls``) rather
+  than a green health check over a dead port.
+- ``GET /metrics`` — the full telemetry registry summary (counters,
+  gauges, timing histograms with p50/p95 and first-call-apart compile
+  latencies), the same shape ``telemetry.json`` persists.
+
+Request handling runs through :func:`trlx_tpu.supervisor.bounded_call`
+(``serve.request_timeout``): a request wedged behind a hung decode
+raises SeamTimeout in the handler (503 + ``fault/seam_timeouts``)
+instead of holding the socket forever. The ``serve_request`` chaos seam
+fires at handler entry so the error path is drillable
+(``serve_request:exc`` -> HTTP 500 with the injected error).
+"""
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from trlx_tpu import telemetry
+from trlx_tpu.serve.batcher import MicroBatcher, QueueFull
+from trlx_tpu.supervisor import RunSupervisor, SeamTimeout, bounded_call, chaos
+
+#: counters pre-registered when a server starts so the ``serve/*`` series
+#: exist in /metrics from the first scrape, not the first event
+_SERVE_COUNTERS = (
+    "serve/requests",
+    "serve/responses",
+    "serve/batches",
+    "serve/rejected",
+    "serve/request_errors",
+    "serve/generated_tokens",
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server via type(); silences the default per-request stderr log
+    server_ref: "InferenceServer" = None
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        return
+
+    # -- helpers --------------------------------------------------------- #
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._json(code, {"error": message})
+
+    # -- routes ---------------------------------------------------------- #
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        srv = self.server_ref
+        if self.path == "/healthz":
+            self._json(200, {
+                "status": "ok",
+                "warmed": srv.engine.warmed,
+                "buckets": [list(b) for b in srv.engine.buckets],
+                "queue_depth": srv.batcher.queue_depth(),
+            })
+        elif self.path == "/metrics":
+            self._json(200, telemetry.summary())
+        else:
+            self._error(404, f"no route '{self.path}' (have /generate "
+                             f"[POST], /healthz, /metrics)")
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
+        if self.path != "/generate":
+            self._error(404, f"no POST route '{self.path}'")
+            return
+        srv = self.server_ref
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, f"bad JSON body: {e}")
+            return
+        try:
+            payload = bounded_call(
+                lambda: srv.handle_generate(body),
+                timeout=srv.engine.serve.request_timeout,
+                label="serve_request",
+            )
+        except QueueFull as e:
+            self._error(429, str(e))
+            return
+        except (ValueError, TypeError) as e:
+            self._error(400, str(e))
+            return
+        except (SeamTimeout, TimeoutError) as e:
+            self._error(503, str(e))
+            return
+        except Exception as e:
+            telemetry.inc("serve/request_errors")
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        self._json(200, payload)
+
+
+class InferenceServer:
+    """Engine + batcher + supervisor + HTTP listener, one object.
+
+    ``start()`` warms the bucket lattice (unless ``warmup=False``),
+    starts the batcher worker (which enters the serve supervisor when
+    ``serve.stall_timeout`` > 0), and binds the HTTP thread; ``stop()``
+    tears all three down. Usable in-process (tests pass port=0 and read
+    ``server.port``) or via ``python -m trlx_tpu.serve``.
+    """
+
+    def __init__(self, engine, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.engine = engine
+        cfg = engine.serve
+        self.host = cfg.host if host is None else host
+        self.port = cfg.port if port is None else port
+        sup = None
+        if cfg.stall_timeout > 0:
+            # serving has no checkpoint to rescue; a stalled-decode
+            # escalation aborts the process (exit 70) so the scheduler
+            # restarts a fresh, working replica
+            sup = RunSupervisor(
+                stall_timeout=cfg.stall_timeout, stall_action="abort"
+            )
+        self.supervisor = sup
+        self.batcher = MicroBatcher(engine, run_supervisor=sup)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- request semantics ---------------------------------------------- #
+
+    def handle_generate(self, body: dict) -> dict:
+        """One request end-to-end: tokenize, submit, wait, shape the
+        response. Runs inside bounded_call — raising is the error path
+        (the handler maps exception types to HTTP codes)."""
+        chaos.maybe_inject("serve_request")
+        if "tokens" in body:
+            tokens = [int(t) for t in body["tokens"]]
+        elif "prompt" in body:
+            tokens = self.engine.encode_prompt(str(body["prompt"]))
+        else:
+            raise ValueError("body needs 'prompt' (string) or 'tokens' "
+                             "(token-id list)")
+        max_new = body.get("max_new_tokens")
+        seed = body.get("seed")
+        req = self.batcher.submit(
+            tokens, max_new_tokens=max_new,
+            seed=None if seed is None else int(seed),
+        )
+        req.wait()  # bounded by the caller's bounded_call
+        return {
+            "tokens": req.result,
+            "text": self.engine.tokenizer.decode(
+                req.result, skip_special_tokens=True
+            ),
+            "bucket": list(req.shape),
+            "latency_ms": round(req.latency_s * 1000.0, 3),
+            "queue_depth": self.batcher.queue_depth(),
+        }
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self, warmup: bool = True) -> "InferenceServer":
+        telemetry.predeclare(_SERVE_COUNTERS)
+        if warmup and not self.engine.warmed:
+            latencies = self.engine.warmup()
+            for name, secs in latencies.items():
+                print(f"[trlx_tpu.serve] warmed {name}: {secs:.3f}s "
+                      f"first call (compile)", file=sys.stderr, flush=True)
+        self.batcher.start()
+        handler = type("Handler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]  # resolve port=0
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="trlx-serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        print(f"[trlx_tpu.serve] listening on http://{self.host}:"
+              f"{self.port} (buckets {[list(b) for b in self.engine.buckets]})",
+              file=sys.stderr, flush=True)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+            self._http_thread = None
+        self.batcher.stop()
+
+    def serve_forever(self) -> None:
+        """Block the calling thread until interrupted (the CLI's tail)."""
+        try:
+            while True:
+                threading.Event().wait(3600.0)
+        except KeyboardInterrupt:
+            print("[trlx_tpu.serve] interrupted; shutting down",
+                  file=sys.stderr, flush=True)
+        finally:
+            self.stop()

@@ -56,6 +56,13 @@ from trlx_tpu.supervisor.seams import (  # noqa: F401  (re-exports)
     bounded_call,
 )
 
+#: the containment clock: deadline/budget arithmetic for stall watchdogs
+#: and the serve micro-batcher's flush deadlines sources monotonic time
+#: from HERE, not ad-hoc time.* calls — control-flow clocks live with the
+#: supervision machinery, measurements go through trlx_tpu.telemetry
+#: (enforced by tests/test_style.py)
+monotonic = _monotonic
+
 #: reusable no-op context manager (nullcontext is reentrant)
 NULL_CM = contextlib.nullcontext()
 

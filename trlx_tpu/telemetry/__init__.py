@@ -202,6 +202,19 @@ def inc(name: str, n: float = 1.0) -> None:
         _session.registry.inc(name, n)
 
 
+def predeclare(names) -> None:
+    """Register counters at 0 in the active session (no-op without one).
+
+    Subsystem-scoped twin of ``_PREDECLARED_COUNTERS``: a subsystem that
+    only runs in SOME processes (the serving endpoint's ``serve/*``
+    family) declares its series when IT starts, so dashboards/scrapes see
+    zeros instead of missing keys — without polluting every training
+    run's emission with counters that can never fire there."""
+    if _session is not None:
+        for name in names:
+            _session.registry.counters.setdefault(name, 0.0)
+
+
 def set_gauge(name: str, value: float) -> None:
     if _session is not None:
         _session.registry.set_gauge(name, value)

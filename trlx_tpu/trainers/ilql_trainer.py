@@ -361,6 +361,8 @@ class JaxILQLTrainer(BaseRLTrainer):
                 "iter_count": self.iter_count,
                 "rng": np.asarray(jax.random.key_data(self._rng)).tolist(),
             },
+            # checkpoints are self-describing (see the PPO trainer's note)
+            "config": self.config.to_nested_dict(),
         }
 
     def set_components(self, components: Dict) -> None:

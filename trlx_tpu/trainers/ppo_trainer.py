@@ -525,6 +525,9 @@ class JaxPPOTrainer(BaseRLTrainer):
                 "kl_coef": self.kl_ctl.value,
                 "rng": np.asarray(jax.random.key_data(self._rng)).tolist(),
             },
+            # checkpoints are self-describing: the serve CLI rebuilds the
+            # policy from this (trlx_tpu.serve); restore ignores it
+            "config": self.config.to_nested_dict(),
         }
 
     def set_components(self, components: Dict) -> None:
