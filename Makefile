@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults telemetry chaos serve
+.PHONY: style check test faults telemetry chaos serve serve-soak
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -45,9 +45,19 @@ chaos:
 
 # inference-serving tier (trlx_tpu/serve, docs "Serving"): bucketed AOT
 # decode engine (checkpoint restore + strip, zero steady-state
-# recompiles), dynamic micro-batcher (deadline flush, bucket rounding,
-# queue-overflow admission control), HTTP endpoint parity e2e, and the
-# serve_decode/serve_request chaos containment paths. Part of the
-# non-slow tier-1 set; this target runs just them.
+# recompiles), the static micro-batcher (deadline flush, bucket
+# rounding, queue-overflow admission control), the continuous-batching
+# slot scheduler (test_slots.py: prefill/decode-step parity vs one-shot
+# generate(), step-level harvest + slot reuse mid-decode, occupancy
+# metrics, and the chaos drill on the serve_admit seam — hang = watchdog
+# stall, exc = contained batch failure), HTTP endpoint parity e2e, and
+# the serve_decode/serve_request containment paths. Part of the non-slow
+# tier-1 set; this target runs just them. The slow-marked soak
+# (hundreds of mixed-length requests, zero recompiles, zero slot leaks)
+# is opt-in via `make serve-soak`.
 serve:
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
+		tests/test_slots.py -q -m 'not slow'
+
+serve-soak:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py -q -m slow

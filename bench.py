@@ -972,6 +972,124 @@ def bench_quality(cycles=200):
     }
 
 
+def bench_serving(n_requests=96, trace_seed=17):
+    """Mixed-length serving trace replayed against BOTH decode drivers —
+    ``static`` (PR-4 batch-to-completion micro-batcher) and ``slots``
+    (the continuous-batching slot scheduler) — on the same engine and
+    weights, so the A/B isolates the scheduler.
+
+    The trace is the regime batch-to-completion is worst at: prompt
+    lengths 2..16 and max_new_tokens skewed short (half the requests ask
+    for <= 8 of the 48-token gen extent), submitted as one burst.
+    Static decodes every batch to the full bucket gen extent and short
+    requests ride long batches; slots harvests each request at ITS OWN
+    max_new_tokens and refills freed slots each step. Records useful
+    (returned, de-padded) tokens/sec and request-latency p50/p95 for
+    each driver."""
+    import jax
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.serve import InferenceEngine, MicroBatcher, ServeConfig
+    from trlx_tpu.serve.slots import SlotScheduler
+
+    telemetry.start()
+    config = TRLConfig.from_dict({
+        "model": {
+            "model_path": "from-config", "tokenizer_path": "byte",
+            "model_type": "JaxPPOTrainer", "num_layers_unfrozen": 2,
+            "model_spec": {"vocab_size": 50257, "n_layer": 12,
+                           "n_head": 12, "d_model": 768,
+                           "n_positions": 1024},
+            "compute_dtype": "bfloat16",
+        },
+        "train": {
+            "n_ctx": 64, "epochs": 1, "total_steps": 4, "batch_size": 8,
+            "grad_clip": 1.0, "lr_ramp_steps": 0, "lr_decay_steps": 4,
+            "weight_decay": 1e-6, "learning_rate_init": 1e-3,
+            "learning_rate_target": 1e-3, "log_interval": 10**9,
+            "checkpoint_interval": 10**9, "eval_interval": 10**9,
+            "pipeline": "PPOPipeline", "orchestrator": "PPOOrchestrator",
+            "input_size": 4, "gen_size": 48, "seed": 0,
+            "telemetry": False,
+        },
+        "method": {
+            "name": "ppoconfig", "num_rollouts": 8, "chunk_size": 8,
+            "ppo_epochs": 1,
+            "gen_kwargs": {"max_length": 48, "min_length": 48,
+                           "top_k": 0, "top_p": 1.0, "do_sample": True},
+        },
+    })
+    serve_cfg = ServeConfig(
+        buckets=[[8, 16, 48], [16, 16, 48]],
+        max_wait_ms=8.0, max_queue=max(256, n_requests),
+        scheduler="slots", slots=16,
+    )
+    engine = InferenceEngine(config, serve=serve_cfg)
+
+    rng = np.random.default_rng(trace_seed)
+    trace = [
+        (
+            [int(t) for t in rng.integers(1, 250, size=rng.integers(2, 17))],
+            int(rng.choice([4, 8, 16, 32, 48],
+                           p=[0.3, 0.2, 0.2, 0.15, 0.15])),
+        )
+        for _ in range(n_requests)
+    ]
+
+    def replay(driver):
+        t0 = time.perf_counter()
+        reqs = [
+            driver.submit(tokens, max_new_tokens=mn) for tokens, mn in trace
+        ]
+        for r in reqs:
+            r.wait(timeout=600.0)
+        dt = time.perf_counter() - t0
+        tokens_out = sum(len(r.result) for r in reqs)
+        lat = sorted(r.latency_s for r in reqs)
+        p50 = lat[len(lat) // 2]
+        p95 = lat[min(int(0.95 * (len(lat) - 1)), len(lat) - 1)]
+        return tokens_out / dt, p50 * 1e3, p95 * 1e3
+
+    # static first (its warmup compiles the one-shot bucket lattice)
+    engine.warmup()
+    static = MicroBatcher(engine).start()
+    try:
+        static_tok_s, static_p50, static_p95 = replay(static)
+    finally:
+        static.stop()
+    log(f"serve[static]: {static_tok_s:,.1f} useful tok/s, "
+        f"p50 {static_p50:.0f} ms, p95 {static_p95:.0f} ms")
+
+    slots = SlotScheduler(engine)
+    slots.warmup()
+    slots.start()
+    try:
+        slots_tok_s, slots_p50, slots_p95 = replay(slots)
+    finally:
+        slots.stop()
+    log(f"serve[slots]:  {slots_tok_s:,.1f} useful tok/s, "
+        f"p50 {slots_p50:.0f} ms, p95 {slots_p95:.0f} ms "
+        f"({slots_tok_s / max(static_tok_s, 1e-9):.2f}x static)")
+    jax.block_until_ready(engine.blocks)
+    return {
+        "serve_mixed_tokens_per_sec": round(slots_tok_s, 1),
+        "serve_mixed_p50_latency_ms": round(slots_p50, 1),
+        "serve_mixed_p95_latency_ms": round(slots_p95, 1),
+        "serve_mixed_tokens_per_sec_static": round(static_tok_s, 1),
+        "serve_mixed_p50_latency_ms_static": round(static_p50, 1),
+        "serve_mixed_p95_latency_ms_static": round(static_p95, 1),
+        "serve_mixed_vs_static": round(
+            slots_tok_s / max(static_tok_s, 1e-9), 3
+        ),
+        "serve_mixed_workload": (
+            f"{n_requests}-request burst, gpt2-124M geometry, prompts "
+            f"2..16 tok, max_new skewed short over a 48-token gen "
+            f"extent; useful (returned) tokens/sec, slots pool=16"
+        ),
+    }
+
+
 def _reclaim_device_memory():
     """Drop dead leg-local trainers' device buffers before the next leg.
 
@@ -1052,6 +1170,16 @@ def main():
     log(f"train_step: {step_dt*1e3:.1f} ms "
         f"({tokens_per_step/step_dt:,.0f} tok/s)"
         f"{f', MFU {train_mfu:.1%}' if train_mfu else ''}")
+
+    # ---- mixed-length serving trace: static vs slots scheduler -----------
+    t_leg = time.perf_counter()
+    try:
+        serving = bench_serving()
+    except Exception as e:  # must not sink the headline metric
+        log(f"serving bench skipped: {e!r}")
+        serving = {}
+    _reclaim_device_memory()
+    log(f"[leg] serving: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- long-context train step (fused Pallas attention path) -----------
     t_leg = time.perf_counter()
@@ -1318,6 +1446,7 @@ def main():
         # exp_time + update_time == med within timer noise
         "exp_time_sec": round(exp_times[med_idx], 3),
         "update_time_sec": round(med - exp_times[med_idx], 3),
+        **serving,
         **long_ctx,
         **ilql,
         **xl,

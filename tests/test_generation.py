@@ -244,6 +244,131 @@ def test_greedy_skips_warps_unchanged():
         )
 
 
+def _eos_hungry_extras(eos, fallback=1):
+    """extras_fn that replaces the model's logits with a fixed
+    distribution whose argmax is ALWAYS eos (fallback token second) —
+    the construction the min_new_tokens window tests need, independent
+    of what the random model would sample."""
+
+    def extras(h_normed, logits, prev_tok):
+        fixed = jnp.full_like(logits, -5.0)
+        fixed = fixed.at[:, fallback].set(5.0)
+        return fixed.at[:, eos].set(10.0)
+
+    return extras
+
+
+@pytest.mark.parametrize("min_new", [1, 3])
+def test_min_new_tokens_suppression_window(min_new):
+    """A row whose argmax is eos from step 0 must emit exactly
+    ``min_new_tokens`` real (non-eos) tokens, then the eos — eos is
+    NEG_INF-masked strictly inside the window and free at its boundary."""
+    spec, policy, params, blocks, embed, ln_f = setup("gpt2")
+    eos, fallback, G = 7, 1, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 4), 1, 97)
+    mask = jnp.ones((2, 4), jnp.int32)
+    cfg = GenerationConfig(
+        gen_size=G, sampling=SamplingParams(do_sample=False),
+        eos_token_id=eos, pad_token_id=0, min_new_tokens=min_new,
+    )
+    fn = jax.jit(
+        lambda b, e, lf, p, m, rng: generate(
+            spec, b, e, lf, p, m, rng, cfg, compute_dtype=jnp.float32,
+            cache_dtype=jnp.float32,
+            extras_fn=_eos_hungry_extras(eos, fallback),
+        )
+    )
+    out = fn(blocks, embed, ln_f, prompt, mask, jax.random.PRNGKey(0))
+    gen = np.asarray(out.gen_tokens)
+    gmask = np.asarray(out.gen_mask)
+    for row in range(2):
+        # min_new real tokens (the suppressed-eos fallback), then eos
+        np.testing.assert_array_equal(gen[row, :min_new], fallback)
+        assert gen[row, min_new] == eos
+        np.testing.assert_array_equal(gen[row, min_new + 1:], 0)
+        assert gmask[row].sum() == min_new + 1  # eos token counts
+
+
+def test_min_new_equals_gen_size_suppresses_eos_fully():
+    """The fixed-length pin (min_length == max_length ->
+    min_new_tokens == gen_size): eos stays suppressed at EVERY step, so
+    an eos-hungry model still emits gen_size real tokens and gen_mask
+    never drops."""
+    spec, policy, params, blocks, embed, ln_f = setup("gpt2")
+    eos, G = 7, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (1, 4), 1, 97)
+    mask = jnp.ones((1, 4), jnp.int32)
+    cfg = GenerationConfig(
+        gen_size=G, sampling=SamplingParams(do_sample=False),
+        eos_token_id=eos, pad_token_id=0, min_new_tokens=G,
+    )
+    fn = jax.jit(
+        lambda b, e, lf, p, m, rng: generate(
+            spec, b, e, lf, p, m, rng, cfg, compute_dtype=jnp.float32,
+            cache_dtype=jnp.float32, extras_fn=_eos_hungry_extras(eos),
+        )
+    )
+    out = fn(blocks, embed, ln_f, prompt, mask, jax.random.PRNGKey(0))
+    gen = np.asarray(out.gen_tokens[0])
+    assert eos not in gen
+    assert np.asarray(out.gen_mask[0]).sum() == G
+
+
+def test_from_gen_kwargs_min_length_boundary_pin():
+    """min_length == max_length must map to FULL suppression
+    (min_new_tokens == gen_size) exactly at the boundary; one below the
+    pin leaves a one-token eos window."""
+    cfg = GenerationConfig.from_gen_kwargs(
+        8, {"min_length": 12, "max_length": 12}, prompt_len=4
+    )
+    assert cfg.min_new_tokens == cfg.gen_size == 8
+    cfg = GenerationConfig.from_gen_kwargs(
+        8, {"min_length": 11, "max_length": 12}, prompt_len=4
+    )
+    assert cfg.min_new_tokens == 7 < cfg.gen_size
+
+
+def test_eos_early_exit_parity_with_plain_scan(monkeypatch):
+    """The lax.cond early-exit guard (all rows finished -> cheap no-op
+    step) must be invisible in the outputs: tokens and gen_mask
+    bit-match the plain-scan path on a batch that terminates early."""
+    import trlx_tpu.models.generation as gen_mod
+
+    spec, policy, params, blocks, embed, ln_f = setup("gpt2")
+    eos = 7
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (3, 4), 1, 97)
+    mask = jnp.ones((3, 4), jnp.int32)
+    cfg = GenerationConfig(
+        gen_size=8, sampling=SamplingParams(do_sample=False),
+        eos_token_id=eos, pad_token_id=0, min_new_tokens=2,
+    )
+
+    def run():
+        fn = jax.jit(
+            lambda b, e, lf, p, m, rng: generate(
+                spec, b, e, lf, p, m, rng, cfg, compute_dtype=jnp.float32,
+                cache_dtype=jnp.float32, extras_fn=_eos_hungry_extras(eos),
+            )
+        )
+        return fn(blocks, embed, ln_f, prompt, mask, jax.random.PRNGKey(0))
+
+    guarded = run()
+    # every row terminates at step 2 (min_new=2 window + eos): the guard
+    # really fires for steps 3..7
+    assert np.asarray(guarded.gen_mask).sum(axis=1).tolist() == [3, 3, 3]
+    monkeypatch.setattr(gen_mod, "_EOS_EARLY_EXIT", False)
+    plain = run()
+    np.testing.assert_array_equal(
+        np.asarray(guarded.gen_tokens), np.asarray(plain.gen_tokens)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(guarded.gen_mask), np.asarray(plain.gen_mask)
+    )
+    np.testing.assert_allclose(
+        np.asarray(guarded.gen_logprobs), np.asarray(plain.gen_logprobs)
+    )
+
+
 def test_sampling_key_accepts_raw_rbg_data():
     """ADVICE r04: raw 4-word uint32 key data is already rbg-shaped — it
     must wrap as-is (tiling to 8 words raises inside wrap_key_data), and

@@ -80,11 +80,15 @@ def tiny_config_dict(do_sample=False):
     }
 
 
+# scheduler pinned to the batch-to-completion path: this module is the
+# static driver's tier (and the slots A/B baseline); the
+# continuous-batching slot scheduler has its own tier in test_slots.py
 SERVE = ServeConfig(
     buckets=[[2, 8, 8], [4, 8, 8], [4, 16, 8]],
     max_wait_ms=40.0,
     max_queue=64,
     request_timeout=30.0,
+    scheduler="static",
 )
 
 
@@ -460,7 +464,7 @@ def test_checkpoint_to_endpoint_parity_e2e(tmp_path, seed):
     registry = telemetry.start().registry
     serve_cfg = ServeConfig(
         buckets=[[8, 8, 8]], max_wait_ms=250.0, max_queue=64,
-        request_timeout=60.0,
+        request_timeout=60.0, scheduler="static",
     )
     # config=None: the architecture comes from the checkpoint's own
     # embedded meta.json config — the self-describing-checkpoint path
@@ -554,13 +558,21 @@ def test_cli_bucket_parsing():
         parse_buckets("8x32")
     args = build_parser().parse_args(
         ["--checkpoint", "c", "--buckets", "2x8x8", "--port", "0",
-         "--max-wait-ms", "5", "--max-queue", "7"]
+         "--max-wait-ms", "5", "--max-queue", "7",
+         "--scheduler", "static", "--slots", "3"]
     )
     from trlx_tpu.serve.__main__ import serve_config_from_args
 
     cfg = serve_config_from_args(args)
     assert cfg.buckets == [[2, 8, 8]]
     assert cfg.port == 0 and cfg.max_wait_ms == 5 and cfg.max_queue == 7
+    assert cfg.scheduler == "static" and cfg.slots == 3
+    # flags unset: the ServeConfig defaults survive (slots is the default
+    # driver)
+    bare = serve_config_from_args(
+        build_parser().parse_args(["--checkpoint", "c"])
+    )
+    assert bare.scheduler == "slots" and bare.slots == 0
 
 
 def test_serve_config_roundtrip():

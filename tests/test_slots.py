@@ -1,0 +1,463 @@
+"""Continuous-batching slot-scheduler tests (trlx_tpu/serve/slots +
+models/generation slot primitives): device-level prefill/decode-step
+parity against one-shot ``generate()``, step-level harvest + immediate
+slot reuse mid-decode (the acceptance e2e), zero steady-state
+recompiles, the ``serve_admit`` chaos containment paths, the HTTP
+surface under ``serve.scheduler: slots``, and the slow-marked
+mixed-length soak (zero recompiles, zero slot leaks).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu import telemetry
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.generation import (
+    _segments_of,
+    decode_step,
+    generate,
+    init_slot_pool,
+    init_slot_state,
+    prefill_into_slots,
+)
+from trlx_tpu.serve import InferenceEngine, InferenceServer, ServeConfig
+from trlx_tpu.serve.slots import SlotScheduler
+from trlx_tpu.supervisor import RunSupervisor, chaos
+from test_serve import tiny_config_dict
+
+SERVE_SLOTS = ServeConfig(
+    buckets=[[2, 8, 8], [4, 8, 8], [4, 16, 8]],
+    max_queue=64,
+    request_timeout=30.0,
+    scheduler="slots",
+    slots=4,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    telemetry.start()
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    return InferenceEngine(cfg, serve=SERVE_SLOTS)
+
+
+@pytest.fixture()
+def fresh_registry():
+    session = telemetry.start()
+    yield session.registry
+    telemetry.start()
+
+
+@pytest.fixture()
+def scheduler(engine, fresh_registry):
+    s = SlotScheduler(engine)
+    s.warmup()
+    s.start()
+    yield s
+    s.stop()
+
+
+def direct_generate(engine, rows, bucket, gen_size=8):
+    """One-shot generate() at the same bucket — the parity oracle."""
+    tokens, mask = engine.pad_batch(rows, bucket)
+    gen_cfg = engine._gen_base._replace(gen_size=gen_size)
+    return jax.jit(
+        lambda b, e, lf, t, m, r: generate(
+            engine.spec, b, e, lf, t, m, r, gen_cfg,
+            compute_dtype=jnp.float32,
+        )
+    )(engine.blocks, engine.embed, engine.ln_f, tokens, mask,
+      jax.random.PRNGKey(0))
+
+
+# --------------------------------------------------------------------- #
+# device primitives: parity with one-shot generate()
+# --------------------------------------------------------------------- #
+
+
+def test_slot_primitives_parity_with_staggered_admission(engine):
+    """Greedy slot decode must emit tokens bit-identical to one-shot
+    generate() per row — including a row ADMITTED MID-DECODE into a
+    freshly built pool (the scheduling move the pool exists for) and a
+    left-padded prompt."""
+    spec = engine.spec
+    cfg = engine._gen_base._replace(gen_size=8)
+    _, seg_sizes = _segments_of(engine.blocks)
+    S, T = 3, 16
+    pool = init_slot_pool(spec, seg_sizes, S, T)
+    state = init_slot_state(S, T, spec.vocab_size)
+
+    pf = jax.jit(
+        lambda pool, st, t, m, sid, mn: prefill_into_slots(
+            spec, engine.blocks, engine.embed, engine.ln_f, pool, st,
+            t, m, sid, mn, compute_dtype=jnp.float32,
+        )
+    )
+    sf = jax.jit(
+        lambda pool, st, seed: decode_step(
+            spec, engine.blocks, engine.embed, engine.ln_f, pool, st,
+            seed, cfg, compute_dtype=jnp.float32,
+        )
+    )
+
+    rows = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7, 9, 3]]
+    tokens, mask = engine.pad_batch(rows[:2], (2, 8, 0))
+    # slots out of order + one filler at the drop sentinel
+    pool, state = pf(
+        pool, state, np.vstack([tokens, tokens[:1]]),
+        np.vstack([mask, mask[:1]]),
+        np.array([2, 0, S], np.int32), np.array([8, 8, 1], np.int32),
+    )
+    got = {0: [], 1: [], 2: []}
+    for step in range(3):
+        pool, state, tok, em, _ = sf(pool, state, np.int32(step))
+        tok, em = np.asarray(tok), np.asarray(em)
+        for s in (2, 0):
+            if em[s]:
+                got[s].append(int(tok[s]))
+    # admit row 3 into slot 1 while the others are mid-decode
+    t3, m3 = engine.pad_batch(rows[2:], (2, 8, 0))
+    pool, state = pf(
+        pool, state, t3, m3, np.array([1, S], np.int32),
+        np.array([8, 1], np.int32),
+    )
+    for step in range(3, 14):
+        pool, state, tok, em, _ = sf(pool, state, np.int32(step))
+        tok, em = np.asarray(tok), np.asarray(em)
+        for s in (2, 0, 1):
+            if em[s]:
+                got[s].append(int(tok[s]))
+
+    oracle = direct_generate(engine, rows, (4, 8, 8))
+    for i, slot in enumerate((2, 0, 1)):
+        assert got[slot] == engine.depad_row(oracle, i, 8), (
+            f"slot {slot} (row {i}) diverged from one-shot generate()"
+        )
+
+
+def test_prefill_drop_sentinel_touches_nothing(engine):
+    """An all-sentinel prefill (what warmup runs) must leave pool and
+    lanes byte-identical — the mode='drop' contract."""
+    spec = engine.spec
+    _, seg_sizes = _segments_of(engine.blocks)
+    S, T = 2, 16
+    pool = init_slot_pool(spec, seg_sizes, S, T)
+    state = init_slot_state(S, T, spec.vocab_size)
+    tokens = np.zeros((2, 8), np.int32)
+    mask = np.ones((2, 8), np.int32)
+    new_pool, new_state = jax.jit(
+        lambda pool, st, t, m, sid, mn: prefill_into_slots(
+            spec, engine.blocks, engine.embed, engine.ln_f, pool, st,
+            t, m, sid, mn, compute_dtype=jnp.float32,
+        )
+    )(pool, state, tokens, mask, np.full((2,), S, np.int32),
+      np.ones((2,), np.int32))
+    for a, b in zip(jax.tree_util.tree_leaves((pool, state)),
+                    jax.tree_util.tree_leaves((new_pool, new_state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------------------- #
+# scheduler: the acceptance e2e
+# --------------------------------------------------------------------- #
+
+
+def test_mixed_length_parity_and_slot_reuse_e2e(engine, fresh_registry):
+    """The tentpole acceptance scenario: concurrent mixed-length
+    requests return token-identical output to one-shot generate() at the
+    same bucket with zero steady-state recompiles, and a short request
+    demonstrably completes (slot freed + reused by a queued request)
+    while a long request is still decoding."""
+    s = SlotScheduler(engine, slots=2)  # force contention on a tiny pool
+    s.warmup()
+    assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+    # submit BEFORE starting the worker so the first admission
+    # deterministically takes [long, short] and the third starves
+    long = s.submit([1, 2, 3, 4], max_new_tokens=8)
+    short = s.submit([9, 8], max_new_tokens=1)
+    third = s.submit([5, 5, 5], max_new_tokens=2)
+    s.start()
+    try:
+        for r in (long, short, third):
+            r.wait(timeout=60.0)
+
+        # token parity per row against the (4, 8, 8) bucket oracle
+        rows = [long.tokens, short.tokens, third.tokens]
+        oracle = direct_generate(engine, rows, (4, 8, 8))
+        for i, (req, mn) in enumerate(
+            zip((long, short, third), (8, 1, 2))
+        ):
+            assert req.result == engine.depad_row(oracle, i, mn)
+
+        # the step-level scheduling proof, from the event log: short's
+        # slot is freed and REUSED by the third request strictly before
+        # the long request finishes
+        events = list(s.events)
+        free_short = events.index(("free", short_slot(events, short), short))
+        admit_third = next(
+            i for i, ev in enumerate(events)
+            if ev[0] == "admit" and ev[2] is third
+        )
+        free_long = next(
+            i for i, ev in enumerate(events)
+            if ev[0] == "free" and ev[2] is long
+        )
+        assert free_short < admit_third < free_long
+        assert events[admit_third][1] == events[free_short][1], (
+            "the third request must reuse the short request's freed slot"
+        )
+
+        # the third request waited for a slot while decode kept stepping
+        assert fresh_registry.counters["serve/preempted_steps"] >= 1.0
+        assert fresh_registry.counters["serve/admissions"] == 3.0
+        assert fresh_registry.counters["serve/evictions"] == 3.0
+        assert fresh_registry.gauges["serve/slot_occupancy"] == 0.0
+        assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+        assert s.free_slots() == 2  # no slot leaked
+    finally:
+        s.stop()
+
+
+def short_slot(events, req):
+    for kind, slot, r in events:
+        if kind == "admit" and r is req:
+            return slot
+    raise AssertionError("request was never admitted")
+
+
+def test_per_request_max_new_bounds_latency(engine, fresh_registry,
+                                            scheduler):
+    """Requests terminate at THEIR OWN max_new_tokens, not the bucket
+    gen extent — the step-level scheduling win the static path cannot
+    express."""
+    reqs = [
+        scheduler.submit([i + 1, 2, 3], max_new_tokens=n)
+        for i, n in enumerate((1, 3, 5, 8, 2, 7))
+    ]
+    for r in reqs:
+        r.wait(timeout=60.0)
+    eos = engine._gen_base.eos_token_id
+    for r in reqs:
+        assert len(r.result) <= r.max_new_tokens
+        if len(r.result) < r.max_new_tokens:  # early only via eos
+            assert r.result[-1] == eos
+    assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+    assert scheduler.free_slots() == scheduler.runtime.num_slots
+
+
+def test_prompt_class_rounding_and_validation(engine, scheduler):
+    with pytest.raises(ValueError, match="empty prompt"):
+        scheduler.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        scheduler.submit([1], max_new_tokens=0)
+    with pytest.raises(ValueError, match="fits no serve bucket"):
+        scheduler.submit([1], max_new_tokens=99)
+    long_prompt = list(range(1, 13))  # rounds to the (16, 8) class
+    req = scheduler.submit(long_prompt, max_new_tokens=2)
+    req.wait(timeout=60.0)
+    assert req.shape == (16, 8)
+    oracle = direct_generate(engine, [long_prompt], (4, 16, 8))
+    assert req.result == engine.depad_row(oracle, 0, 2)
+
+
+def test_queue_overflow_rejected(engine, fresh_registry):
+    from trlx_tpu.serve import QueueFull
+
+    s = SlotScheduler(engine, max_queue=2)  # not started: nothing drains
+    s.submit([1], max_new_tokens=1)
+    s.submit([2], max_new_tokens=1)
+    with pytest.raises(QueueFull, match="retry with backoff"):
+        s.submit([3], max_new_tokens=1)
+    assert fresh_registry.counters["serve/rejected"] == 1.0
+    s.stop()  # pending requests are failed, not stranded
+
+
+def test_stopped_scheduler_fails_pending(engine):
+    s = SlotScheduler(engine)
+    req = s.submit([1, 2], max_new_tokens=2)
+    s.stop()
+    with pytest.raises(RuntimeError, match="scheduler stopped"):
+        req.wait(timeout=1.0)
+
+
+# --------------------------------------------------------------------- #
+# serve_admit chaos containment
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_admit_hang_is_attributable_stall(engine, fresh_registry):
+    """serve_admit:hang wedges the admission phase; the watchdog must
+    attribute the stall to 'serve_admit' (not silence, not a misnamed
+    phase), and releasing the hang fails only that batch while the loop
+    keeps serving."""
+    exit_codes = []
+    sup = RunSupervisor(
+        stall_timeout=0.3, stall_first_timeout=0.3,
+        stall_grace=10_000.0, exit_fn=exit_codes.append,
+    )
+    chaos.configure("serve_admit:hang=60@1")
+    s = SlotScheduler(engine, run_supervisor=sup)
+    s.warmup()
+    s.start()
+    try:
+        req = s.submit([1, 2, 3], max_new_tokens=2)
+        deadline = time.monotonic() + 15.0
+        while sup.stalls == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.stalls >= 1, "watchdog never flagged the hung admission"
+        assert sup.stalled_phase == "serve_admit"
+        assert fresh_registry.counters["fault/stalls"] >= 1.0
+        chaos.reset()  # releases the hang as ChaosHang in the worker
+        with pytest.raises(chaos.ChaosHang):
+            req.wait(timeout=15.0)
+        assert fresh_registry.counters["serve/request_errors"] >= 1.0
+        # the loop survived: a fresh request is admitted and decoded
+        ok = s.submit([4, 5], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+        assert not exit_codes  # grace was huge: no escalation
+    finally:
+        chaos.reset()
+        s.stop()
+
+
+def test_chaos_admit_exc_fails_batch_not_loop(engine, fresh_registry,
+                                              scheduler):
+    chaos.configure("serve_admit:exc@1")
+    try:
+        req = scheduler.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(chaos.ChaosError):
+            req.wait(timeout=30.0)
+        assert scheduler.free_slots() == scheduler.runtime.num_slots
+        ok = scheduler.submit([3, 4], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+    finally:
+        chaos.reset()
+
+
+def test_poisoned_step_fails_live_and_recovers(engine, fresh_registry,
+                                               scheduler):
+    """A decode-step failure (serve_decode:exc) fails the in-flight
+    requests, resets the lanes, and the next request serves normally —
+    the slots twin of the batcher's poisoned-batch containment."""
+    chaos.configure("serve_decode:exc@1")
+    try:
+        req = scheduler.submit([1, 2], max_new_tokens=4)
+        with pytest.raises(chaos.ChaosError):
+            req.wait(timeout=30.0)
+        assert scheduler.free_slots() == scheduler.runtime.num_slots
+        ok = scheduler.submit([3, 4], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+    finally:
+        chaos.reset()
+
+
+# --------------------------------------------------------------------- #
+# HTTP surface under serve.scheduler: slots
+# --------------------------------------------------------------------- #
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=60
+    ) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_endpoint_on_slots_scheduler(engine, fresh_registry):
+    server = InferenceServer(engine, port=0).start(warmup=True)
+    try:
+        status, health = _get(server.port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["scheduler"] == "slots" and health["warmed"]
+        assert health["slots"] == 4 and health["free_slots"] == 4
+
+        prompts = ["a", "bc", "def", "ghij"]
+        results = [None] * len(prompts)
+
+        def call(i):
+            _, results[i] = _post(
+                server.port, {"prompt": prompts[i], "max_new_tokens": 8}
+            )
+
+        threads = [
+            threading.Thread(target=call, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r is not None for r in results)
+
+        rows = [engine.encode_prompt(p) for p in prompts]
+        oracle = direct_generate(engine, rows, (4, 8, 8))
+        for i in range(len(prompts)):
+            assert results[i]["tokens"] == engine.depad_row(oracle, i, 8)
+
+        _, metrics = _get(server.port, "/metrics")
+        assert metrics["counters"]["compile/recompiles"] == 0
+        assert metrics["counters"]["serve/admissions"] >= 4
+        assert metrics["counters"]["serve/evictions"] >= 4
+        assert "serve/preempted_steps" in metrics["counters"]  # predeclared
+        assert "serve/slot_occupancy" in metrics["gauges"]
+        assert any(
+            k.startswith("time/serve/prefill_b") for k in metrics["timings"]
+        )
+        assert "serve/slot_step" in {
+            k.removeprefix("time/") for k in metrics["timings"]
+        }
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# soak: zero recompiles, zero slot leaks at scale
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_soak_mixed_lengths_no_recompiles_no_leaks(engine, fresh_registry):
+    """Hundreds of mixed-length requests through the slot scheduler:
+    every compiled program stays warm (compile/recompiles == 0), every
+    slot returns to the free pool, every completion respects its own
+    max_new_tokens."""
+    rng = np.random.default_rng(0)
+    s = SlotScheduler(engine, max_queue=1024)
+    s.warmup()
+    s.start()
+    try:
+        reqs = []
+        for i in range(300):
+            plen = int(rng.integers(1, 16))
+            tokens = [int(t) for t in rng.integers(0, 250, size=plen)]
+            mn = int(rng.integers(1, 9))
+            reqs.append(s.submit(tokens, max_new_tokens=mn))
+        for r in reqs:
+            r.wait(timeout=300.0)
+        assert all(len(r.result) <= r.max_new_tokens for r in reqs)
+        assert s.queue_depth() == 0
+        assert s.free_slots() == s.runtime.num_slots, "slot leak"
+        assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
+        assert fresh_registry.counters["serve/admissions"] == 300.0
+        assert fresh_registry.counters["serve/evictions"] == 300.0
+        assert fresh_registry.counters.get("serve/request_errors", 0.0) == 0.0
+    finally:
+        s.stop()

@@ -52,6 +52,12 @@ from trlx_tpu.utils import tree_bytes
 
 Params = Dict[str, Any]
 
+# EOS early-exit fast path in generate(): once every row has finished,
+# each remaining scan step runs a cheap predicated no-op instead of a full
+# forward (lax.cond on finished.all()). Module-level so tests can A/B the
+# guarded path against the plain scan (token/gen_mask parity).
+_EOS_EARLY_EXIT = True
+
 # Depth ceiling for the unrolled decode body. What makes the unrolled path
 # fast is the per-layer TUPLE cache leaves in the scan carry (measured:
 # gpt2-xl 48L 9.7-11.8 ms/step unrolled vs 14.7-15.7 for every
@@ -429,7 +435,7 @@ def generate(
             new_cache.append((k_c, v_c))
         return tuple(new_cache), h
 
-    def decode_body(carry, step):
+    def live_step(carry, step):
         cache, logits, h_prev_normed, prev_tok, finished, rng = carry
         rng, key = jax.random.split(rng)
         step_logits = logits
@@ -473,6 +479,34 @@ def generate(
         carry = (cache, next_logits, h_normed[:, 0], tok, finished, rng)
         return carry, (tok, logprob, emitted_mask)
 
+    # EOS early-exit: when termination is possible before gen_size (eos
+    # enabled and not fully suppressed), guard the heavy body with a
+    # scalar cond on finished.all() — a batch that has fully terminated
+    # pays a cheap pass-through step instead of a full forward. The
+    # fixed-length training configs (min_new_tokens == gen_size) keep the
+    # plain scan: the guard could never fire before the last step.
+    early_exit = (
+        _EOS_EARLY_EXIT
+        and config.eos_token_id >= 0
+        and config.min_new_tokens < G
+    )
+
+    def decode_body(carry, step):
+        if not early_exit:
+            return live_step(carry, step)
+
+        def dead(args):
+            carry, _ = args
+            pad = jnp.full((B,), config.pad_token_id, jnp.int32)
+            return carry, (
+                pad, jnp.zeros((B,), jnp.float32), jnp.zeros((B,), bool)
+            )
+
+        def live(args):
+            return live_step(*args)
+
+        return jax.lax.cond(carry[4].all(), dead, live, (carry, step))
+
     if unroll_layers:
         # stacked per-segment prefill buffers -> flat per-layer carry
         # leaves
@@ -505,3 +539,258 @@ def generate(
         gen_mask=gen_mask,
         attention_mask=buffer_mask,
     )
+
+
+# ---------------------------------------------------------------------------
+# Slot-pool decode primitives (continuous batching)
+# ---------------------------------------------------------------------------
+#
+# generate() above is REQUEST-TO-COMPLETION: one program owns its KV cache
+# from prefill through all gen_size steps, so a batch admits nothing until
+# every row is done and a finished row keeps paying full steps. The two
+# primitives below split that monolith for iteration-level scheduling
+# (Orca, Yu et al., OSDI '22) over a PERSISTENT device-resident slot pool
+# (the static-shape analogue of vLLM's block pool, Kwon et al., SOSP '23):
+#
+# - ``prefill_into_slots``: one prompt-bucket forward writing each row's
+#   prompt KV into a named pool slot (scatter, ``mode="drop"`` so filler
+#   rows aimed at the out-of-bounds sentinel vanish) plus its first-step
+#   logits and per-slot lanes;
+# - ``decode_step``: ONE token for all S slots — per-slot cache offsets,
+#   logical positions, finished/active lanes, per-request max_new caps —
+#   returning the emitted tokens to the host scheduler
+#   (trlx_tpu.serve.slots), which harvests finished rows and re-admits
+#   queued requests into the freed slots at every step boundary.
+#
+# Both are meant to be AOT-compiled once per shape (the pool/state shapes
+# are static; ``prefill`` per (batch, prompt_len) bucket, ``decode_step``
+# once) with the pool+state donated, so steady state is two executables
+# and zero recompiles. Numerics match generate() exactly for a row decoded
+# in isolation: masked (invalid) pool positions contribute exact zeros to
+# the attention softmax, so emitted tokens are bit-identical under greedy
+# decode — the parity contract tests/test_slots.py pins.
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode lanes riding next to the KV pool (all leading-S).
+
+    ``valid`` [S, T] marks which pool positions hold real keys (prompt
+    pads and never-written tail stay 0 — the attention mask source);
+    ``offset`` is the next cache write position, ``pos`` the next rotary/
+    logical position (= real tokens so far), ``generated`` the emitted
+    count against the per-request ``max_new`` cap. ``active`` is host
+    occupancy (False = free slot), ``finished`` terminal-for-decode;
+    ``logits`` [S, V] carries each slot's next-token distribution between
+    programs (written by prefill, advanced by every step).
+    """
+
+    valid: jnp.ndarray  # [S, T] int32
+    offset: jnp.ndarray  # [S] int32
+    pos: jnp.ndarray  # [S] int32
+    generated: jnp.ndarray  # [S] int32
+    max_new: jnp.ndarray  # [S] int32
+    active: jnp.ndarray  # [S] bool
+    finished: jnp.ndarray  # [S] bool
+    logits: jnp.ndarray  # [S, V] float32
+
+
+def init_slot_state(num_slots: int, buffer_len: int,
+                    vocab_size: int) -> SlotState:
+    """An all-free pool state: nothing active, everything finished (so a
+    decode step over an empty pool emits nothing)."""
+    S = num_slots
+    return SlotState(
+        valid=jnp.zeros((S, buffer_len), jnp.int32),
+        offset=jnp.zeros((S,), jnp.int32),
+        pos=jnp.zeros((S,), jnp.int32),
+        generated=jnp.zeros((S,), jnp.int32),
+        max_new=jnp.zeros((S,), jnp.int32),
+        active=jnp.zeros((S,), bool),
+        finished=jnp.ones((S,), bool),
+        logits=jnp.zeros((S, vocab_size), jnp.float32),
+    )
+
+
+def init_slot_pool(spec: ModelSpec, seg_sizes, num_slots: int,
+                   buffer_len: int, cache_dtype=jnp.bfloat16):
+    """Per-segment stacked (k, v) pool buffers [L_seg, S, T, Hkv, hd] —
+    the same segment structure generate() keeps, so hydra policies never
+    concatenate their trunk."""
+    return tuple(
+        init_kv_cache(spec, size, num_slots, buffer_len, cache_dtype)
+        for size in seg_sizes
+    )
+
+
+def _segments_of(blocks):
+    segments = tuple(blocks) if isinstance(blocks, (list, tuple)) \
+        else (blocks,)
+    seg_sizes = [
+        jax.tree_util.tree_leaves(s)[0].shape[0] for s in segments
+    ]
+    return segments, seg_sizes
+
+
+def prefill_into_slots(
+    spec: ModelSpec,
+    blocks: Params,
+    embed: Params,
+    ln_f: Params,
+    pool,
+    state: SlotState,
+    prompt_tokens: jnp.ndarray,  # [Bp, P] left-padded
+    prompt_mask: jnp.ndarray,  # [Bp, P]
+    slot_ids: jnp.ndarray,  # [Bp] int32; == num_slots -> dropped filler
+    max_new: jnp.ndarray,  # [Bp] int32 per-request cap
+    compute_dtype=jnp.bfloat16,
+    attention_fn=attention_scores,
+):
+    """Write a prompt bucket's KV + first-step logits into pool slots.
+
+    Runs the exact prefill generate() runs (same ops, local [Bp, P] cache
+    buffer at offset 0), then scatters cache/state rows to ``slot_ids``.
+    Filler rows carry ``slot_ids == num_slots`` (one past the end):
+    every scatter here uses ``mode="drop"``, so they compile the bucket
+    shape without touching any real slot — which is also how warmup
+    compiles each bucket against the live pool for free.
+    """
+    B, P = prompt_tokens.shape
+    T = state.valid.shape[1]
+    if P > T:
+        raise ValueError(
+            f"prefill prompt_len {P} exceeds the slot buffer length {T}"
+        )
+    segments, seg_sizes = _segments_of(blocks)
+    prompt_mask = prompt_mask.astype(jnp.int32)
+    real_len = prompt_mask.sum(axis=-1)
+
+    cache_dtype = jax.tree_util.tree_leaves(pool)[0].dtype
+    cache_segs = [
+        init_kv_cache(spec, size, B, P, cache_dtype) for size in seg_sizes
+    ]
+    positions = positions_from_mask(prompt_mask)
+    h = embed_tokens(embed, spec, prompt_tokens, positions, compute_dtype)
+    bias = causal_mask_bias(prompt_mask)
+    for i, seg in enumerate(segments):
+        h, cache_segs[i] = apply_blocks_with_cache(
+            seg, cache_segs[i], spec, h, bias, positions,
+            cache_offset=jnp.int32(0), attention_fn=attention_fn,
+        )
+    h_last = layer_norm(ln_f, h[:, -1:], spec.layer_norm_epsilon)
+    logits0 = project_logits(embed, spec, h_last)[:, 0]  # [Bp, V]
+
+    rows = slot_ids.astype(jnp.int32)
+    new_pool = []
+    for (k_pool, v_pool), (k_new, v_new) in zip(pool, cache_segs):
+        new_pool.append((
+            k_pool.at[:, rows, :P].set(k_new, mode="drop"),
+            v_pool.at[:, rows, :P].set(v_new, mode="drop"),
+        ))
+
+    valid_rows = jnp.concatenate(
+        [prompt_mask, jnp.zeros((B, T - P), jnp.int32)], axis=1
+    )
+    new_state = SlotState(
+        valid=state.valid.at[rows].set(valid_rows, mode="drop"),
+        offset=state.offset.at[rows].set(P, mode="drop"),
+        pos=state.pos.at[rows].set(real_len, mode="drop"),
+        generated=state.generated.at[rows].set(0, mode="drop"),
+        max_new=state.max_new.at[rows].set(
+            jnp.clip(max_new.astype(jnp.int32), 0, T - P), mode="drop"
+        ),
+        active=state.active.at[rows].set(True, mode="drop"),
+        finished=state.finished.at[rows].set(False, mode="drop"),
+        logits=state.logits.at[rows].set(logits0, mode="drop"),
+    )
+    return tuple(new_pool), new_state
+
+
+def decode_step(
+    spec: ModelSpec,
+    blocks: Params,
+    embed: Params,
+    ln_f: Params,
+    pool,
+    state: SlotState,
+    seed: jnp.ndarray,  # scalar int32 (per-step sampling stream)
+    config: GenerationConfig,
+    compute_dtype=jnp.bfloat16,
+    attention_fn=attention_scores,
+):
+    """One decode step for every pool slot: sample from each slot's
+    carried logits, forward the sampled tokens against the pool (per-slot
+    cache offsets/positions), advance the lanes.
+
+    Returns ``(pool, state, tokens [S], emitted [S], finished [S])`` —
+    ``emitted`` marks slots that produced a real token this step (eos
+    included), ``finished`` the slots now terminal (eos seen, or
+    ``generated`` reached the slot's ``max_new``). Free/finished slots
+    still ride the dense [S] program (static shapes) but emit nothing,
+    advance nothing, and their dropped cache writes touch no valid
+    position — the host scheduler's job is to keep them refilled.
+
+    ``config.gen_size`` is ignored (the cap is per-slot ``max_new``);
+    ``min_new_tokens`` applies per slot against its ``generated`` count.
+    """
+    S = state.offset.shape[0]
+    segments, seg_sizes = _segments_of(blocks)
+    flags = ArchFlags.for_spec(spec)
+
+    step_logits = state.logits
+    if config.eos_token_id >= 0 and config.min_new_tokens > 0:
+        suppress = state.generated < config.min_new_tokens
+        eos_col = step_logits[:, config.eos_token_id]
+        step_logits = step_logits.at[:, config.eos_token_id].set(
+            jnp.where(suppress, NEG_INF, eos_col)
+        )
+    key = _sampling_key(jax.random.PRNGKey(seed))
+    tok = sample_token(key, step_logits, config.sampling)
+    emitted = state.active & ~state.finished
+    tok = jnp.where(emitted, tok, jnp.int32(config.pad_token_id)).astype(
+        jnp.int32
+    )
+    finished = state.finished
+    if config.eos_token_id >= 0:
+        finished = finished | (emitted & (tok == config.eos_token_id))
+    generated = state.generated + emitted.astype(jnp.int32)
+    finished = finished | (state.active & (generated >= state.max_new))
+
+    rows = jnp.arange(S)
+    # mark the fresh token's pool position valid BEFORE attention (the
+    # token attends to itself, as in generate()'s slot_idx <= offset)
+    valid = state.valid.at[rows, state.offset].set(
+        emitted.astype(jnp.int32), mode="drop"
+    )
+    bias = jnp.where(valid > 0, 0.0, NEG_INF)[:, None, None, :].astype(
+        jnp.float32
+    )
+    pos = state.pos[:, None]  # [S, 1] logical position of this token
+    h = embed_tokens(embed, spec, tok[:, None], pos, compute_dtype)
+    new_pool = []
+    for seg, size, (k_c, v_c) in zip(segments, seg_sizes, pool):
+        for i in range(size):
+            p_i = jax.tree_util.tree_map(lambda x, i=i: x[i], seg)
+            h, (k_l, v_l) = block_apply(
+                spec, flags, p_i, h, bias, pos,
+                kv_cache=(k_c[i], v_c[i]),
+                cache_row_offsets=state.offset,
+                attention_fn=attention_fn,
+            )
+            k_c = k_c.at[i].set(k_l)
+            v_c = v_c.at[i].set(v_l)
+        new_pool.append((k_c, v_c))
+    h_normed = layer_norm(ln_f, h, spec.layer_norm_epsilon)
+    next_logits = project_logits(embed, spec, h_normed)[:, 0]  # [S, V]
+
+    adv = emitted.astype(jnp.int32)
+    new_state = SlotState(
+        valid=valid,
+        offset=state.offset + adv,
+        pos=state.pos + adv,
+        generated=generated,
+        max_new=state.max_new,
+        active=state.active,
+        finished=finished,
+        logits=next_logits,
+    )
+    return tuple(new_pool), new_state, tok, emitted, finished

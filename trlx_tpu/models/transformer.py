@@ -280,6 +280,7 @@ def block_apply(
     kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     cache_offset: Optional[jnp.ndarray] = None,
     attention_fn=attention_scores,
+    cache_row_offsets: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """One transformer block on hidden states `h` [B, T, D].
 
@@ -289,6 +290,13 @@ def block_apply(
     buffer; per-row *logical* positions for rotary come from `positions`),
     and attention runs q against the full buffer (decode mode: T is the
     fresh suffix, typically 1).
+
+    `cache_row_offsets` ([B] int32) switches the write to PER-ROW buffer
+    positions — the slot-pool decode mode (trlx_tpu.models.generation
+    `decode_step`), where each slot advances at its own pace. Requires
+    T == 1 (one fresh token per row); rows whose offset is out of bounds
+    are dropped (``mode="drop"``), which is how free/finished slots
+    no-op. `cache_offset` is ignored in this mode.
     """
     B, T, D = h.shape
     H, hd = spec.n_head, spec.head_dim
@@ -318,12 +326,26 @@ def block_apply(
     new_cache = None
     if kv_cache is not None:
         k_cache, v_cache = kv_cache
-        k_full = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), cache_offset, axis=1
-        )
-        v_full = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), cache_offset, axis=1
-        )
+        if cache_row_offsets is not None:
+            if T != 1:
+                raise ValueError(
+                    f"cache_row_offsets (per-row cache writes) requires a "
+                    f"single fresh token per row, got T={T}"
+                )
+            rows = jnp.arange(B)
+            k_full = k_cache.at[rows, cache_row_offsets].set(
+                k[:, 0].astype(k_cache.dtype), mode="drop"
+            )
+            v_full = v_cache.at[rows, cache_row_offsets].set(
+                v[:, 0].astype(v_cache.dtype), mode="drop"
+            )
+        else:
+            k_full = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k.astype(k_cache.dtype), cache_offset, axis=1
+            )
+            v_full = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v.astype(v_cache.dtype), cache_offset, axis=1
+            )
         new_cache = (k_full, v_full)
         a = attention_fn(
             q,

@@ -200,17 +200,17 @@ class PPOOrchestrator(Orchestrator):
         chaos.maybe_inject("rollout")
         trainer = self.rl_model
         n_chunks = handle["n_chunks"]
+        pendings = handle["pendings"]
+        device_reward = getattr(self.reward_fn, "is_device_reward", False)
 
-        all_kls = []
-        all_scores = []
-        for pending in handle["pendings"]:
+        def fetch_tree(pending):
+            """The chunk's host-bound tensors: only what the host reward
+            callback and the KL controller need. Everything per-token
+            stays on device. A mesh-resident learned reward model scores
+            the raw token sequences on device — zero extra transfers (the
+            scores ride the same batched fetch); host reward_fns get
+            decoded texts, the reference contract."""
             out, query, qmask, logprobs, values, kl_rewards, seq_kl = pending
-
-            # a mesh-resident learned reward model scores the raw token
-            # sequences on device — zero extra transfers (the scores ride
-            # the same batched fetch below); host reward_fns get decoded
-            # texts, the reference contract
-            device_reward = getattr(self.reward_fn, "is_device_reward", False)
             if device_reward:
                 # the RM must see the TRUE response validity: out.attention
                 # _mask keeps post-eos pads at 1 (cache-slot validity), so
@@ -224,13 +224,40 @@ class PPOOrchestrator(Orchestrator):
                                                          rm_mask)
             else:
                 scores_dev = ()
+            return (out.sequences, seq_kl, scores_dev)
 
-            # THE one device->host fetch per chunk: only what the host
-            # reward callback and the KL controller need. Everything
-            # per-token stays on device.
+        # double-buffered harvest: the NEXT chunk's device->host copies
+        # start before the CURRENT chunk's host scoring, so reward_fn /
+        # batch_decode time overlaps the next transfer instead of
+        # serializing with it (each fetch on a tunneled TPU costs ~100 ms
+        # of latency regardless of payload)
+        fetch_trees = [None] * n_chunks
+
+        def start_fetch(i):
+            if fetch_trees[i] is None:
+                fetch_trees[i] = fetch_tree(pendings[i])
+            for leaf in jax.tree_util.tree_leaves(fetch_trees[i]):
+                starter = getattr(leaf, "copy_to_host_async", None)
+                if starter is not None and getattr(
+                    leaf, "is_fully_addressable", False
+                ):
+                    starter()
+
+        if pendings:
+            start_fetch(0)
+
+        all_kls = []
+        all_scores = []
+        for i, pending in enumerate(pendings):
+            out, query, qmask, logprobs, values, kl_rewards, seq_kl = pending
+
+            # THE one (blocking) device->host fetch per chunk; the async
+            # copy above usually has it staged already
             sequences, seq_kl_host, scores_host = jax.device_get(
-                (out.sequences, seq_kl, scores_dev)
+                fetch_trees[i]
             )
+            if i + 1 < n_chunks:
+                start_fetch(i + 1)
 
             if device_reward:
                 scores = np.asarray(scores_host, np.float32)

@@ -16,20 +16,28 @@ Three layers (docs/source/serving.rst):
   gen_len) **bucket lattice** through ``utils.aotjit`` so steady-state
   requests never recompile (``compile/recompiles == 0`` is the serving
   invariant);
-- :class:`MicroBatcher` (serve.batcher) — Orca-lineage dynamic
-  micro-batching: requests round up to a compiled shape class and
-  coalesce until the bucket fills or ``max_wait_ms`` passes, with
-  ``max_queue`` admission control;
+- :class:`SlotScheduler` (serve.slots, ``serve.scheduler: slots`` — the
+  default) — continuous batching: step-level scheduling over a
+  persistent device-resident KV **slot pool**; at every decode step
+  finished rows (EOS / per-request ``max_new_tokens``) are harvested,
+  their slots freed immediately, and queued requests admitted via
+  bucketed prefill — short requests never wait for long ones;
+- :class:`MicroBatcher` (serve.batcher, ``serve.scheduler: static``) —
+  the PR-4 batch-to-completion micro-batcher kept for A/B: requests
+  round up to a compiled shape class and coalesce until the bucket
+  fills or ``max_wait_ms`` passes, with ``max_queue`` admission control;
 - :class:`InferenceServer` (serve.server) — stdlib ThreadingHTTPServer
   JSON API (``POST /generate``, ``GET /healthz``, ``GET /metrics``)
   wired into the telemetry registry, the supervisor watchdog
-  (``serve_decode`` phase + heartbeat per batch), bounded request
-  handling, and the ``serve_decode`` / ``serve_request`` chaos seams.
+  (``serve_admit`` / ``serve_decode`` phases + heartbeats), bounded
+  request handling, and the ``serve_admit`` / ``serve_decode`` /
+  ``serve_request`` chaos seams.
 """
 
 from trlx_tpu.serve.batcher import MicroBatcher, QueueFull, Request  # noqa: F401
 from trlx_tpu.serve.engine import InferenceEngine, ServeConfig  # noqa: F401
 from trlx_tpu.serve.server import InferenceServer  # noqa: F401
+from trlx_tpu.serve.slots import SlotScheduler  # noqa: F401
 
 __all__ = [
     "InferenceEngine",
@@ -38,4 +46,5 @@ __all__ = [
     "QueueFull",
     "Request",
     "ServeConfig",
+    "SlotScheduler",
 ]

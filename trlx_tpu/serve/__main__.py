@@ -55,7 +55,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--request-timeout", type=float, default=None,
                    help="per-request walltime bound (503 past it)")
     p.add_argument("--stall-timeout", type=float, default=None,
-                   help="watchdog budget per decoded batch (0 = off)")
+                   help="watchdog budget per decoded batch/step (0 = off)")
+    p.add_argument("--scheduler", choices=("static", "slots"), default=None,
+                   help="decode driver: 'slots' = continuous batching "
+                        "over the persistent KV slot pool (default), "
+                        "'static' = PR-4 batch-to-completion A/B path")
+    p.add_argument("--slots", type=int, default=None,
+                   help="slot-pool size for --scheduler slots "
+                        "(0 = largest compiled batch extent)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip lattice precompilation at startup (first "
                         "request per bucket then pays the compile)")
@@ -76,7 +83,9 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("max_wait_ms", "max_wait_ms"),
                        ("max_queue", "max_queue"),
                        ("request_timeout", "request_timeout"),
-                       ("stall_timeout", "stall_timeout")):
+                       ("stall_timeout", "stall_timeout"),
+                       ("scheduler", "scheduler"),
+                       ("slots", "slots")):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, attr, value)

@@ -1,11 +1,18 @@
 """Dynamic micro-batcher: request coalescing into bucket-shaped decodes.
 
-Orca-style continuous batching (Yu et al., OSDI'22) adapted to a
-static-shape XLA decode: instead of admitting requests into a running
-program (impossible — shapes are compiled in), requests queue, round UP
-to a compiled ``(prompt_len, gen_len)`` shape class (the *bucket
-rounding* rule), and the worker flushes one bucket-shaped batch when
-either
+This is the BATCH-TO-COMPLETION driver (``serve.scheduler: static``) — a
+flushed bucket decodes all its steps before the next batch starts. The
+default serving driver is now the step-level continuous-batching slot
+scheduler (trlx_tpu.serve.slots, ``serve.scheduler: slots``), which
+harvests finished rows and admits queued requests at every decode step;
+this path is kept as its A/B baseline and for workloads where whole-batch
+decodes are preferable (uniform lengths, offline replay).
+
+Deadline-coalesced batching adapted to a static-shape XLA decode:
+instead of admitting requests into a running program (impossible —
+shapes are compiled in), requests queue, round UP to a compiled
+``(prompt_len, gen_len)`` shape class (the *bucket rounding* rule), and
+the worker flushes one bucket-shaped batch when either
 
 - enough same-shape requests queue to fill a compiled batch extent, or
 - the oldest queued request has waited ``max_wait_ms``

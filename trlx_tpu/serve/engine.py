@@ -70,6 +70,17 @@ class ServeConfig:
     :param host / port: bind address for the HTTP endpoint.
     :param seed: base PRNG seed for sampling batches (each decoded batch
         folds in a counter; greedy decode ignores it).
+    :param scheduler: ``"slots"`` (default) drives the continuous-batching
+        slot scheduler (trlx_tpu.serve.slots): step-level harvesting +
+        admission over a persistent KV slot pool, per-request
+        ``max_new_tokens`` termination. ``"static"`` keeps the PR-4
+        batch-to-completion micro-batcher (the A/B baseline bench.py's
+        mixed-length trace replays against).
+    :param slots: slot-pool size for the ``slots`` scheduler; 0 (default)
+        sizes it to the largest compiled batch extent — capacity parity
+        with the static path. Pool HBM is
+        ``2 * n_layer * slots * max(prompt+gen) * kv_heads * head_dim``
+        cache-dtype elements.
     """
 
     buckets: List[List[int]] = field(
@@ -82,6 +93,8 @@ class ServeConfig:
     host: str = "127.0.0.1"
     port: int = 8080
     seed: int = 0
+    scheduler: str = "slots"
+    slots: int = 0
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -144,6 +157,15 @@ class InferenceEngine:
             telemetry.start()
         self.config = config
         self.serve = serve or ServeConfig()
+        if self.serve.scheduler not in ("static", "slots"):
+            raise ValueError(
+                f"serve.scheduler '{self.serve.scheduler}' is not one of: "
+                f"static, slots"
+            )
+        if self.serve.slots < 0:
+            raise ValueError(
+                f"serve.slots={self.serve.slots} must be >= 0 (0 = auto)"
+            )
         self.buckets = _normalize_buckets(self.serve.buckets)
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)
 
@@ -344,6 +366,41 @@ class InferenceEngine:
 
     def default_max_new_tokens(self) -> int:
         return min(g for _, _, g in self.buckets)
+
+    # -- slot-scheduler lattice (trlx_tpu.serve.slots) -------------------- #
+
+    def prompt_classes(self) -> Tuple[Tuple[int, Tuple[int, ...]], ...]:
+        """Distinct prompt lengths with their admission batch extents,
+        smallest prompt first — the slot scheduler's prefill lattice
+        (prefill shape is (batch, prompt_len); the gen extent lives in
+        per-slot ``max_new`` lanes, not in the compiled shape)."""
+        classes = {}
+        for b, p, _ in self.buckets:
+            classes.setdefault(p, set()).add(b)
+        return tuple(
+            (p, tuple(sorted(classes[p]))) for p in sorted(classes)
+        )
+
+    def prefill_batch_sizes(self, prompt_len: int) -> Tuple[int, ...]:
+        """Ascending admission batch extents for one prompt class."""
+        for p, extents in self.prompt_classes():
+            if p == prompt_len:
+                return extents
+        raise ValueError(
+            f"prompt_len {prompt_len} is not a compiled prompt class "
+            f"(have {[p for p, _ in self.prompt_classes()]})"
+        )
+
+    def slot_count(self) -> int:
+        """Slot-pool size: ``serve.slots``, or the largest compiled batch
+        extent (capacity parity with the static path) when 0."""
+        return self.serve.slots or max(b for b, _, _ in self.buckets)
+
+    def slot_buffer_len(self) -> int:
+        """Per-slot KV buffer length: the largest prompt+gen extent any
+        bucket needs (bucket validation already pinned it under
+        n_positions)."""
+        return max(p + g for _, p, g in self.buckets)
 
     # -- decode ---------------------------------------------------------- #
 

@@ -45,6 +45,12 @@ _SERVE_COUNTERS = (
     "serve/rejected",
     "serve/request_errors",
     "serve/generated_tokens",
+    # slot-scheduler family (trlx_tpu.serve.slots): admissions into pool
+    # slots, harvested/freed slots, steps decoded while requests starved
+    # for a free slot
+    "serve/admissions",
+    "serve/evictions",
+    "serve/preempted_steps",
 )
 
 
@@ -73,12 +79,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
         srv = self.server_ref
         if self.path == "/healthz":
-            self._json(200, {
+            body = {
                 "status": "ok",
-                "warmed": srv.engine.warmed,
+                "warmed": srv.warmed,
+                "scheduler": srv.engine.serve.scheduler,
                 "buckets": [list(b) for b in srv.engine.buckets],
                 "queue_depth": srv.batcher.queue_depth(),
-            })
+            }
+            free = getattr(srv.batcher, "free_slots", None)
+            if free is not None:
+                body["slots"] = srv.batcher.runtime.num_slots
+                body["free_slots"] = free()
+            self._json(200, body)
         elif self.path == "/metrics":
             self._json(200, telemetry.summary())
         else:
@@ -121,10 +133,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class InferenceServer:
-    """Engine + batcher + supervisor + HTTP listener, one object.
+    """Engine + decode driver + supervisor + HTTP listener, one object.
 
-    ``start()`` warms the bucket lattice (unless ``warmup=False``),
-    starts the batcher worker (which enters the serve supervisor when
+    The decode driver is picked by ``serve.scheduler``: ``"slots"``
+    (default) runs the continuous-batching :class:`SlotScheduler`
+    (trlx_tpu.serve.slots — step-level harvest/admission over the
+    persistent KV slot pool); ``"static"`` runs the PR-4
+    batch-to-completion :class:`MicroBatcher`. Both expose the same
+    submit/wait surface, so the HTTP layer is scheduler-agnostic.
+
+    ``start()`` warms the decode programs (unless ``warmup=False``),
+    starts the driver worker (which enters the serve supervisor when
     ``serve.stall_timeout`` > 0), and binds the HTTP thread; ``stop()``
     tears all three down. Usable in-process (tests pass port=0 and read
     ``server.port``) or via ``python -m trlx_tpu.serve``.
@@ -145,9 +164,22 @@ class InferenceServer:
                 stall_timeout=cfg.stall_timeout, stall_action="abort"
             )
         self.supervisor = sup
-        self.batcher = MicroBatcher(engine, run_supervisor=sup)
+        if cfg.scheduler == "slots":
+            from trlx_tpu.serve.slots import SlotScheduler
+
+            self.batcher = SlotScheduler(engine, run_supervisor=sup)
+        else:
+            self.batcher = MicroBatcher(engine, run_supervisor=sup)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
+
+    @property
+    def warmed(self) -> bool:
+        """Whether this server's decode programs are compiled: the slot
+        scheduler's prefill/step executables, or the static lattice."""
+        if self.engine.serve.scheduler == "slots":
+            return self.batcher.warmed
+        return self.engine.warmed
 
     # -- request semantics ---------------------------------------------- #
 
@@ -184,8 +216,13 @@ class InferenceServer:
 
     def start(self, warmup: bool = True) -> "InferenceServer":
         telemetry.predeclare(_SERVE_COUNTERS)
-        if warmup and not self.engine.warmed:
-            latencies = self.engine.warmup()
+        if self.engine.serve.scheduler == "slots":
+            telemetry.set_gauge("serve/slot_occupancy", 0.0)
+        if warmup and not self.warmed:
+            if self.engine.serve.scheduler == "slots":
+                latencies = self.batcher.warmup()
+            else:
+                latencies = self.engine.warmup()
             for name, secs in latencies.items():
                 print(f"[trlx_tpu.serve] warmed {name}: {secs:.3f}s "
                       f"first call (compile)", file=sys.stderr, flush=True)

@@ -1,0 +1,469 @@
+"""Continuous-batching slot scheduler: iteration-level serving decode.
+
+The PR-4 micro-batcher (trlx_tpu.serve.batcher) batches
+*request-to-completion*: a flushed bucket decodes all ``gen_size`` steps
+before the next batch starts, short requests wait behind long ones, and
+filler rows decode at full cost. This module schedules at the *step*
+level instead (Orca, Yu et al., OSDI '22), over a persistent
+device-resident KV **slot pool** (the static-shape analogue of vLLM's
+paged KV blocks, Kwon et al., SOSP '23):
+
+- :class:`SlotPoolRuntime` owns the pool + per-slot lanes and the two
+  AOT-compiled device primitives (trlx_tpu.models.generation):
+  ``prefill_into_slots`` — one executable per (batch, prompt_len)
+  admission bucket — and ``decode_step`` — ONE executable for all slots.
+  Pool and state are donated on accelerators, so a step updates the pool
+  in place; warmup runs every prefill bucket against the live pool with
+  out-of-bounds sentinel slot ids (scatters ``mode="drop"`` — compiles
+  the shape, touches nothing), then one decode step. Steady state is
+  first-compiles only: ``compile/recompiles == 0`` stays the serving
+  invariant.
+- :class:`SlotScheduler` runs the host loop: at every step boundary it
+  **harvests** finished rows (EOS, or the request's own
+  ``max_new_tokens`` — not the bucket's gen extent), frees their slots
+  immediately, and **admits** queued requests into free slots via
+  bucketed prefill. Short requests no longer wait for long ones; filler
+  rows become free slots; steady-state **slot occupancy**
+  (``serve/slot_occupancy``) replaces ``batch_fill_ratio`` as the
+  utilization signal.
+
+Containment mirrors the static path: the worker thread enters the serve
+supervisor; admission runs as the ``serve_admit`` phase (chaos seam
+``serve_admit`` — a wedged admission is a stall the watchdog can
+attribute, not silence) and each decode step as ``serve_decode`` with a
+heartbeat per step. A poisoned step fails the live requests, resets the
+lanes, and keeps serving; a poisoned admission fails only its batch.
+
+Metrics (trlx_tpu.telemetry): ``serve/admissions`` / ``serve/evictions``
+/ ``serve/preempted_steps`` counters, ``serve/slot_occupancy`` gauge,
+plus the shared ``serve/requests|responses|rejected|request_errors|
+generated_tokens`` family and ``serve/request_latency`` histogram. The
+old batch-to-completion path stays available as ``serve.scheduler:
+static`` for A/B (bench.py replays the same mixed-length trace against
+both).
+"""
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu import supervisor, telemetry
+from trlx_tpu.serve.batcher import QueueFull, Request
+from trlx_tpu.supervisor import chaos, monotonic
+
+#: filler rows in a prefill bucket aim at slot id == num_slots — one past
+#: the pool end, dropped by every mode="drop" scatter on device
+
+
+class SlotPoolRuntime:
+    """Device half of the slot scheduler: pool buffers, per-slot lanes,
+    and the compiled prefill/step executables."""
+
+    def __init__(self, engine, num_slots: Optional[int] = None):
+        import jax
+
+        from trlx_tpu.models.generation import (
+            _segments_of,
+            init_slot_pool,
+            init_slot_state,
+        )
+
+        self.engine = engine
+        self.num_slots = engine.slot_count() if num_slots is None \
+            else int(num_slots)
+        self.buffer_len = engine.slot_buffer_len()
+        self._segments, self._seg_sizes = _segments_of(engine.blocks)
+        self._vocab = engine.spec.vocab_size
+        # CPU has no buffer donation; donating there only prints warnings
+        self._donate = jax.default_backend() != "cpu"
+        self.pool = init_slot_pool(
+            engine.spec, self._seg_sizes, self.num_slots, self.buffer_len
+        )
+        self.state = init_slot_state(
+            self.num_slots, self.buffer_len, self._vocab
+        )
+        self._prefill_fns = {}  # (Bp, P) -> aot_jit'd closure
+        self._step_fn = None
+        self.warmed = False
+
+    # -- compiled closures ----------------------------------------------- #
+
+    def _prefill_fn(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            from trlx_tpu.models.generation import prefill_into_slots
+            from trlx_tpu.utils.aotjit import aot_jit
+
+            spec = self.engine.spec
+            compute = self.engine._compute_dtype
+
+            def run(blocks, embed, ln_f, pool, state, tokens, mask,
+                    slot_ids, max_new):
+                return prefill_into_slots(
+                    spec, blocks, embed, ln_f, pool, state, tokens, mask,
+                    slot_ids, max_new, compute_dtype=compute,
+                )
+
+            fn = self._prefill_fns[bucket] = aot_jit(
+                run, donate_argnums=(3, 4) if self._donate else (),
+            )
+        return fn
+
+    def _decode_fn(self):
+        if self._step_fn is None:
+            from trlx_tpu.models.generation import decode_step
+            from trlx_tpu.utils.aotjit import aot_jit
+
+            spec = self.engine.spec
+            cfg = self.engine._gen_base
+            compute = self.engine._compute_dtype
+
+            def run(blocks, embed, ln_f, pool, state, seed):
+                return decode_step(
+                    spec, blocks, embed, ln_f, pool, state, seed, cfg,
+                    compute_dtype=compute,
+                )
+
+            self._step_fn = aot_jit(
+                run, donate_argnums=(3, 4) if self._donate else (),
+            )
+        return self._step_fn
+
+    # -- spans ------------------------------------------------------------ #
+
+    def prefill_span(self, bucket) -> str:
+        Bp, P = bucket
+        return f"serve/prefill_b{Bp}p{P}"
+
+    STEP_SPAN = "serve/slot_step"
+
+    # -- device calls ------------------------------------------------------ #
+
+    def prefill(self, bucket, tokens: np.ndarray, mask: np.ndarray,
+                slot_ids, max_new) -> None:
+        """Admit one prompt bucket into the pool (filler rows carry the
+        out-of-bounds sentinel and are dropped on device)."""
+        e = self.engine
+        fn = self._prefill_fn(bucket)
+        with telemetry.span(self.prefill_span(bucket)):
+            self.pool, self.state = fn(
+                e.blocks, e.embed, e.ln_f, self.pool, self.state,
+                np.ascontiguousarray(tokens, np.int32),
+                np.ascontiguousarray(mask, np.int32),
+                np.asarray(slot_ids, np.int32),
+                np.asarray(max_new, np.int32),
+            )
+
+    def step(self, seed: int):
+        """One decode step for every slot; returns host-side
+        (tokens [S], emitted [S], finished [S]) numpy arrays."""
+        import jax
+
+        e = self.engine
+        fn = self._decode_fn()
+        with telemetry.span(self.STEP_SPAN):
+            self.pool, self.state, tok, emitted, finished = fn(
+                e.blocks, e.embed, e.ln_f, self.pool, self.state,
+                np.int32(seed),
+            )
+            return jax.device_get((tok, emitted, finished))
+
+    def reset_lanes(self) -> None:
+        """Fresh all-free per-slot lanes AND pool buffers — the
+        poisoned-step containment path. Rebuilding the pool matters under
+        donation: a program that failed mid-execution may have consumed
+        the donated buffers, so the old arrays cannot be trusted."""
+        from trlx_tpu.models.generation import init_slot_pool, init_slot_state
+
+        self.pool = init_slot_pool(
+            self.engine.spec, self._seg_sizes, self.num_slots,
+            self.buffer_len,
+        )
+        self.state = init_slot_state(
+            self.num_slots, self.buffer_len, self._vocab
+        )
+
+    # -- warmup ------------------------------------------------------------ #
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every admission bucket + the decode step up front.
+        All rows aim at the sentinel slot, so the live pool is untouched;
+        each compile is a first call in its own executable cache (the
+        ``compile/recompiles == 0`` invariant). Returns {span:
+        first-call seconds}."""
+        pad = self.engine.pad_token_id
+        latencies = {}
+        for P, extents in self.engine.prompt_classes():
+            for Bp in extents:
+                tokens = np.full((Bp, P), pad, np.int32)
+                tokens[:, -1] = 0
+                mask = np.zeros((Bp, P), np.int32)
+                mask[:, -1] = 1
+                self.prefill(
+                    (Bp, P), tokens, mask,
+                    np.full((Bp,), self.num_slots, np.int32),
+                    np.ones((Bp,), np.int32),
+                )
+        self.step(0)
+        tel = telemetry.current()
+        if tel is not None:
+            spans = [
+                self.prefill_span((Bp, P))
+                for P, extents in self.engine.prompt_classes()
+                for Bp in extents
+            ] + [self.STEP_SPAN]
+            for span in spans:
+                hist = tel.registry.hists.get(f"time/{span}")
+                if hist is not None and hist.first is not None:
+                    latencies[span] = hist.first
+        self.warmed = True
+        telemetry.set_gauge(
+            "serve/slot_programs_warmed", len(self._prefill_fns) + 1
+        )
+        return latencies
+
+
+class _LiveSlot:
+    """Host bookkeeping for one occupied slot."""
+
+    __slots__ = ("request", "tokens")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.tokens: List[int] = []
+
+
+class SlotScheduler:
+    """The continuous-batching decode driver: one worker thread running
+    the admit -> step -> harvest loop over the slot pool.
+
+    Drop-in for :class:`trlx_tpu.serve.batcher.MicroBatcher` on the
+    server side: same ``submit``/``start``/``stop``/``queue_depth``
+    surface, same :class:`Request` completion contract.
+    """
+
+    def __init__(self, engine, max_queue: Optional[int] = None,
+                 run_supervisor=None, slots: Optional[int] = None):
+        self.engine = engine
+        cfg = engine.serve
+        self.max_queue = cfg.max_queue if max_queue is None else max_queue
+        self.run_supervisor = run_supervisor
+        self.runtime = SlotPoolRuntime(engine, num_slots=slots)
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._free = list(range(self.runtime.num_slots))
+        self._live: Dict[int, _LiveSlot] = {}
+        self._step_counter = 0
+        self._starved = False  # queue waited while no slot was free
+        #: (event, slot, request) ring — "admit"/"free"; the e2e tests
+        #: read it to prove a freed slot was reused mid-decode
+        self.events = deque(maxlen=4096)
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def warmup(self) -> Dict[str, float]:
+        return self.runtime.warmup()
+
+    @property
+    def warmed(self) -> bool:
+        return self.runtime.warmed
+
+    def start(self) -> "SlotScheduler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="trlx-serve-slots", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        live = list(self._live.values())
+        self._live.clear()
+        self._free = list(range(self.runtime.num_slots))
+        for req in pending + [s.request for s in live]:
+            req.error = RuntimeError("serve slot scheduler stopped")
+            req.done.set()
+
+    # -- submission ------------------------------------------------------- #
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def submit(self, tokens: List[int],
+               max_new_tokens: Optional[int] = None,
+               seed: Optional[int] = None) -> Request:
+        """Enqueue one request; same validation/admission contract as the
+        static micro-batcher (ValueError when no bucket fits, QueueFull
+        past ``max_queue``). ``seed`` is accepted for surface parity but
+        the sampling stream is per-STEP here (a request's draws depend on
+        which steps it rides), so only greedy decode is exactly
+        reproducible."""
+        if not tokens:
+            raise ValueError("empty prompt: at least one token is required")
+        if max_new_tokens is None:
+            max_new_tokens = self.engine.default_max_new_tokens()
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
+        shape = self.engine.pick_shape(len(tokens), max_new_tokens)
+        req = Request(list(tokens), max_new_tokens, shape, seed=seed)
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                telemetry.inc("serve/rejected")
+                raise QueueFull(
+                    f"serve queue is full ({self.max_queue} pending); "
+                    f"retry with backoff (serve.max_queue bounds queueing "
+                    f"delay — raise it to trade latency for acceptance)"
+                )
+            self._queue.append(req)
+            telemetry.inc("serve/requests")
+            telemetry.set_gauge("serve/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    # -- worker ----------------------------------------------------------- #
+
+    def _occupancy(self) -> float:
+        return len(self._live) / max(self.runtime.num_slots, 1)
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots, one prompt-class bucket
+        at a time (FIFO head's class first). Sets ``_starved`` when
+        requests are left waiting with no free slot — the next step then
+        counts as ``serve/preempted_steps``."""
+        while True:
+            with self._cond:
+                self._starved = bool(self._queue) and not self._free
+                if not self._queue or not self._free:
+                    return
+                P = self._queue[0].shape[0]
+                extents = self.engine.prefill_batch_sizes(P)
+                take = min(
+                    sum(1 for r in self._queue if r.shape[0] == P),
+                    len(self._free), extents[-1],
+                )
+                batch = [r for r in self._queue if r.shape[0] == P][:take]
+                for r in batch:
+                    self._queue.remove(r)
+                telemetry.set_gauge("serve/queue_depth", len(self._queue))
+            with supervisor.phase("serve_admit"):
+                try:
+                    chaos.maybe_inject("serve_admit")
+                    self._prefill_batch(batch, P, extents)
+                except Exception as e:
+                    # a poisoned admission fails ITS requests; the pool
+                    # lanes were only touched if the device call ran, and
+                    # dropped-sentinel scatters cannot corrupt live slots
+                    telemetry.inc("serve/request_errors", len(batch))
+                    for r in batch:
+                        r.error = e
+                        r.done.set()
+                supervisor.beat()
+
+    def _prefill_batch(self, batch: List[Request], P: int, extents) -> None:
+        Bp = next(b for b in extents if b >= len(batch))
+        slots = [self._free.pop() for _ in batch]
+        sentinel = self.runtime.num_slots
+        slot_ids = slots + [sentinel] * (Bp - len(batch))
+        rows = [r.tokens for r in batch]
+        tokens, mask = self.engine.pad_batch(rows, (Bp, P, 0))
+        max_new = [r.max_new_tokens for r in batch]
+        max_new += [1] * (Bp - len(batch))
+        try:
+            self.runtime.prefill((Bp, P), tokens, mask, slot_ids, max_new)
+        except Exception:
+            self._free.extend(slots)  # nothing was admitted
+            raise
+        for r, s in zip(batch, slots):
+            self._live[s] = _LiveSlot(r)
+            self.events.append(("admit", s, r))
+        telemetry.inc("serve/admissions", len(batch))
+        telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
+
+    def _step(self) -> None:
+        with supervisor.phase("serve_decode"):
+            chaos.maybe_inject("serve_decode")
+            seed = self.engine.serve.seed + self._step_counter
+            self._step_counter += 1
+            tok, emitted, finished = self.runtime.step(seed)
+            supervisor.beat()
+        if self._starved:
+            telemetry.inc("serve/preempted_steps")
+        done_at = monotonic()
+        emitted_total = 0
+        for slot in list(self._live):
+            live = self._live[slot]
+            if emitted[slot]:
+                live.tokens.append(int(tok[slot]))
+                emitted_total += 1
+            if finished[slot]:
+                req = live.request
+                req.result = live.tokens
+                req.latency_s = done_at - req.enqueued_at
+                telemetry.observe("serve/request_latency", req.latency_s)
+                req.done.set()
+                del self._live[slot]
+                self._free.append(slot)
+                self.events.append(("free", slot, req))
+                telemetry.inc("serve/evictions")
+                telemetry.inc("serve/responses")
+        if emitted_total:
+            telemetry.inc("serve/generated_tokens", emitted_total)
+            tel = telemetry.current()
+            if tel is not None:
+                hist = tel.registry.hists.get(f"time/{self.runtime.STEP_SPAN}")
+                if hist is not None and hist.last > 0:
+                    telemetry.set_gauge(
+                        "serve/tokens_per_sec", emitted_total / hist.last
+                    )
+        telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
+
+    def _fail_live(self, error: BaseException) -> None:
+        """Poisoned-step containment: fail every in-flight request, free
+        all slots, reset the device lanes, keep the loop serving."""
+        live = list(self._live.values())
+        self._live.clear()
+        self._free = list(range(self.runtime.num_slots))
+        telemetry.inc("serve/request_errors", len(live))
+        for s in live:
+            s.request.error = error
+            s.request.done.set()
+        self.runtime.reset_lanes()
+        telemetry.set_gauge("serve/slot_occupancy", 0.0)
+
+    def _run(self) -> None:
+        sup_cm = self.run_supervisor
+        if sup_cm is None:
+            import contextlib
+
+            sup_cm = contextlib.nullcontext()
+        with sup_cm:
+            while not self._stop.is_set():
+                self._admit()
+                if not self._live:
+                    with self._cond:
+                        if not self._queue and not self._stop.is_set():
+                            self._cond.wait(timeout=0.1)
+                    continue
+                try:
+                    self._step()
+                except Exception as e:
+                    self._fail_live(e)

@@ -17,11 +17,15 @@ A schedule is a ``;``-separated list of rules::
   exception consumes a retry), plus the phase seams ``rollout``,
   ``ppo_update``, ``ilql_update``, ``eval``, and ``checkpoint_save``
   (fired once at phase entry). The serving subsystem (trlx_tpu.serve)
-  adds ``serve_decode`` (fired inside the batcher's supervised
-  ``serve_decode`` phase, before the decode dispatch — a ``hang`` there
-  drives the watchdog stall path) and ``serve_request`` (fired at
-  request-handler entry — an ``exc`` surfaces as the HTTP 500 error
-  path).
+  adds ``serve_decode`` (fired inside the supervised ``serve_decode``
+  phase, before the decode dispatch — the static batcher's whole-batch
+  decode and the slot scheduler's per-step decode alike; a ``hang``
+  there drives the watchdog stall path), ``serve_admit`` (fired inside
+  the slot scheduler's ``serve_admit`` phase after an admission batch is
+  selected, before its prefill dispatch — a ``hang`` makes a wedged
+  admission an attributable stall, an ``exc`` fails just that batch),
+  and ``serve_request`` (fired at request-handler entry — an ``exc``
+  surfaces as the HTTP 500 error path).
 - ``action``: ``hang`` (block ``param`` seconds, default 3600 — a
   bounded seam times out, the watchdog sees everything else), ``exc``
   (raise :class:`ChaosError`), ``slow`` (sleep ``param`` seconds, default
