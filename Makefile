@@ -50,14 +50,19 @@ chaos:
 # slot scheduler (test_slots.py: prefill/decode-step parity vs one-shot
 # generate(), step-level harvest + slot reuse mid-decode, occupancy
 # metrics, and the chaos drill on the serve_admit seam — hang = watchdog
-# stall, exc = contained batch failure), HTTP endpoint parity e2e, and
-# the serve_decode/serve_request containment paths. Part of the non-slow
+# stall, exc = contained batch failure), the paged KV pool + radix
+# prefix cache (test_paged.py: allocator/radix semantics, greedy-parity
+# sweep across page sizes, prefix-hit prefill skipping, exhaustion
+# queue-not-crash, serve_prefix_match chaos drill, pool health on
+# /healthz, contiguous fallback), HTTP endpoint parity e2e, and the
+# serve_decode/serve_request containment paths. Part of the non-slow
 # tier-1 set; this target runs just them. The slow-marked soak
 # (hundreds of mixed-length requests, zero recompiles, zero slot leaks)
 # is opt-in via `make serve-soak`.
 serve:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
-		tests/test_slots.py -q -m 'not slow'
+		tests/test_slots.py tests/test_paged.py -q -m 'not slow'
 
 serve-soak:
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py -q -m slow
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \
+		tests/test_paged.py -q -m slow

@@ -32,12 +32,16 @@ from trlx_tpu.serve.slots import SlotScheduler
 from trlx_tpu.supervisor import RunSupervisor, chaos
 from test_serve import tiny_config_dict
 
+# pinned to the CONTIGUOUS layout: this module is the PR-5 pool's
+# coverage (the serve.kv_layout: contiguous A/B fallback); the paged
+# pool + radix prefix cache get their own full pass in test_paged.py
 SERVE_SLOTS = ServeConfig(
     buckets=[[2, 8, 8], [4, 8, 8], [4, 16, 8]],
     max_queue=64,
     request_timeout=30.0,
     scheduler="slots",
     slots=4,
+    kv_layout="contiguous",
 )
 
 

@@ -43,6 +43,7 @@ from trlx_tpu.models.transformer import (
     causal_mask_bias,
     embed_tokens,
     init_kv_cache,
+    init_paged_kv_cache,
     layer_norm,
     positions_from_mask,
     project_logits,
@@ -569,6 +570,18 @@ def generate(
 # in isolation: masked (invalid) pool positions contribute exact zeros to
 # the attention softmax, so emitted tokens are bit-identical under greedy
 # decode — the parity contract tests/test_slots.py pins.
+#
+# Both primitives also run against a PAGED pool (init_page_pool +
+# SlotState.pages page tables, block_apply's paged mode — the
+# static-shape rebuild of vLLM's PagedAttention allocator): KV lives in
+# fixed-size pages shared across slots, a slot's logical position p maps
+# through its table to (page, offset), and prefill can start at a
+# nonzero page-aligned offset with the committed prefix gathered as
+# attention context (prefix_context=True — the radix-prefix-cache path,
+# trlx_tpu.serve.paged). Page tables are DATA, not shape, so the
+# executable count and the zero-recompile contract are unchanged; the
+# parity contract extends to any page size / prefix split
+# (tests/test_paged.py pins the sweep).
 
 
 class SlotState(NamedTuple):
@@ -582,6 +595,13 @@ class SlotState(NamedTuple):
     occupancy (False = free slot), ``finished`` terminal-for-decode;
     ``logits`` [S, V] carries each slot's next-token distribution between
     programs (written by prefill, advanced by every step).
+
+    ``pages`` [S, max_pages] int32 is the per-slot page table under the
+    PAGED pool layout (``serve.kv_layout: paged``): entry j names the
+    physical pool page holding the slot's logical positions
+    [j * page_size, (j+1) * page_size); unallocated entries carry the
+    out-of-bounds :data:`PAGE_SENTINEL` so device scatters drop them.
+    ``None`` selects the contiguous per-slot layout (the PR-5 pool).
     """
 
     valid: jnp.ndarray  # [S, T] int32
@@ -592,12 +612,20 @@ class SlotState(NamedTuple):
     active: jnp.ndarray  # [S] bool
     finished: jnp.ndarray  # [S] bool
     logits: jnp.ndarray  # [S, V] float32
+    pages: Optional[jnp.ndarray] = None  # [S, max_pages] int32 | None
 
 
-def init_slot_state(num_slots: int, buffer_len: int,
-                    vocab_size: int) -> SlotState:
+#: page-table entry meaning "no page here": comfortably past any real
+#: pool's page count, so every mode="drop" scatter through it vanishes
+#: and every read gather clamps into masked garbage
+PAGE_SENTINEL = 2**30
+
+
+def init_slot_state(num_slots: int, buffer_len: int, vocab_size: int,
+                    max_pages: Optional[int] = None) -> SlotState:
     """An all-free pool state: nothing active, everything finished (so a
-    decode step over an empty pool emits nothing)."""
+    decode step over an empty pool emits nothing). ``max_pages`` builds
+    the paged variant (all page-table entries at the drop sentinel)."""
     S = num_slots
     return SlotState(
         valid=jnp.zeros((S, buffer_len), jnp.int32),
@@ -608,6 +636,9 @@ def init_slot_state(num_slots: int, buffer_len: int,
         active=jnp.zeros((S,), bool),
         finished=jnp.ones((S,), bool),
         logits=jnp.zeros((S, vocab_size), jnp.float32),
+        pages=None if max_pages is None else jnp.full(
+            (S, max_pages), PAGE_SENTINEL, jnp.int32
+        ),
     )
 
 
@@ -618,6 +649,17 @@ def init_slot_pool(spec: ModelSpec, seg_sizes, num_slots: int,
     concatenate their trunk."""
     return tuple(
         init_kv_cache(spec, size, num_slots, buffer_len, cache_dtype)
+        for size in seg_sizes
+    )
+
+
+def init_page_pool(spec: ModelSpec, seg_sizes, num_pages: int,
+                   page_size: int, cache_dtype=jnp.bfloat16):
+    """Per-segment (k, v) PAGE pools [L_seg, num_pages, page_size, Hkv,
+    hd]: the block-granular replacement for init_slot_pool — HBM is
+    sized in pages shared by all slots, not slots x worst-case length."""
+    return tuple(
+        init_paged_kv_cache(spec, size, num_pages, page_size, cache_dtype)
         for size in seg_sizes
     )
 
@@ -644,6 +686,10 @@ def prefill_into_slots(
     max_new: jnp.ndarray,  # [Bp] int32 per-request cap
     compute_dtype=jnp.bfloat16,
     attention_fn=attention_scores,
+    page_tables: Optional[jnp.ndarray] = None,  # [Bp, max_pages] int32
+    page_size: Optional[int] = None,
+    start: Optional[jnp.ndarray] = None,  # [Bp] int32 page-aligned prefix
+    prefix_context: bool = False,
 ):
     """Write a prompt bucket's KV + first-step logits into pool slots.
 
@@ -653,6 +699,26 @@ def prefill_into_slots(
     every scatter here uses ``mode="drop"``, so they compile the bucket
     shape without touching any real slot — which is also how warmup
     compiles each bucket against the live pool for free.
+
+    ``page_tables`` switches to the PAGED pool layout: ``pool`` is then
+    the global page pool (init_page_pool) and ``prompt_tokens`` /
+    ``prompt_mask`` must be RIGHT-padded — under right padding a slot's
+    buffer position equals its logical token position, so two requests
+    sharing a token prefix share identical page CONTENT, which is what
+    makes radix prefix caching content-addressable (KV of a causal model
+    depends only on the tokens before it, not on pad placement; masked
+    pad positions contribute exactly zero either way, so greedy outputs
+    stay bit-identical to one-shot left-padded ``generate()``).
+
+    ``start`` ([Bp] int32, page-aligned, default zeros) is each row's
+    already-committed prefix length: the tokens passed in are only the
+    UNMATCHED SUFFIX (right-padded into the bucket's [Bp, P] shape) and
+    are written at logical positions ``start + j``. With
+    ``prefix_context=True`` the suffix attends to the committed prefix
+    pages gathered from the pool (the ``prefill_suffix`` executable — a
+    prefix hit skips the matched tokens' forward entirely); with
+    ``False`` (all-zero ``start``) attention stays local to the prompt,
+    which is cheaper and exactly mirrors the contiguous prefill.
     """
     B, P = prompt_tokens.shape
     T = state.valid.shape[1]
@@ -662,6 +728,12 @@ def prefill_into_slots(
         )
     segments, seg_sizes = _segments_of(blocks)
     prompt_mask = prompt_mask.astype(jnp.int32)
+    if page_tables is not None:
+        return _prefill_into_pages(
+            spec, segments, seg_sizes, embed, ln_f, pool, state,
+            prompt_tokens, prompt_mask, slot_ids, max_new, compute_dtype,
+            attention_fn, page_tables, page_size, start, prefix_context,
+        )
     real_len = prompt_mask.sum(axis=-1)
 
     cache_dtype = jax.tree_util.tree_leaves(pool)[0].dtype
@@ -701,6 +773,113 @@ def prefill_into_slots(
         active=state.active.at[rows].set(True, mode="drop"),
         finished=state.finished.at[rows].set(False, mode="drop"),
         logits=state.logits.at[rows].set(logits0, mode="drop"),
+    )
+    return tuple(new_pool), new_state
+
+
+def _prefill_into_pages(
+    spec, segments, seg_sizes, embed, ln_f, pool, state,
+    prompt_tokens, prompt_mask, slot_ids, max_new, compute_dtype,
+    attention_fn, page_tables, page_size, start, prefix_context,
+):
+    """Paged half of prefill_into_slots (see its docstring): suffix
+    forward + block-scatter through per-row page tables; state rows
+    (valid/offset/pos/pages/logits) scattered to ``slot_ids``."""
+    B, P = prompt_tokens.shape
+    T = state.valid.shape[1]
+    if page_size is None or page_size <= 0:
+        raise ValueError(f"paged prefill needs page_size, got {page_size}")
+    max_pages = page_tables.shape[1]
+    if max_pages * page_size != T:
+        raise ValueError(
+            f"page table extent {max_pages} x {page_size} != slot buffer "
+            f"length {T}"
+        )
+    flags = ArchFlags.for_spec(spec)
+    suffix_len = prompt_mask.sum(axis=-1)  # [Bp] real (unmatched) tokens
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    start = start.astype(jnp.int32)
+    real_len = start + suffix_len  # [Bp] total committed positions after
+    # right padding: suffix token j sits at logical position start + j
+    positions = start[:, None] + jnp.arange(P)[None, :]
+    h = embed_tokens(embed, spec, prompt_tokens, positions, compute_dtype)
+
+    if not prefix_context:
+        # no committed prefix: local causal prefill (the exact ops the
+        # contiguous path runs), then one block-scatter into the pages
+        cache_dtype = jax.tree_util.tree_leaves(pool)[0].dtype
+        cache_segs = [
+            init_kv_cache(spec, size, B, P, cache_dtype)
+            for size in seg_sizes
+        ]
+        bias = causal_mask_bias(prompt_mask)
+        for i, seg in enumerate(segments):
+            h, cache_segs[i] = apply_blocks_with_cache(
+                seg, cache_segs[i], spec, h, bias, positions,
+                cache_offset=jnp.int32(0), attention_fn=attention_fn,
+            )
+        pos_buf = jnp.arange(P)
+        pids = page_tables[:, pos_buf // page_size]  # [Bp, P]
+        ioff = pos_buf % page_size  # [P], broadcasts against pids
+        new_pool = []
+        for (k_pool, v_pool), (k_new, v_new) in zip(pool, cache_segs):
+            new_pool.append((
+                k_pool.at[:, pids, ioff].set(k_new, mode="drop"),
+                v_pool.at[:, pids, ioff].set(v_new, mode="drop"),
+            ))
+    else:
+        # prefix-suffix prefill: each suffix token attends to the
+        # committed prefix pages (gathered inside block_apply's paged
+        # mode) plus the suffix tokens written before it — causality over
+        # LOGICAL positions: key position p is visible to suffix token j
+        # of row b iff p <= start[b] + j. Prefix positions (< start) are
+        # whole committed pages, so no extra validity lane is needed;
+        # positions past the row's own writes are masked by causality.
+        allowed = jnp.arange(T)[None, None, :] <= positions[:, :, None]
+        bias = jnp.where(allowed, 0.0, NEG_INF).astype(
+            jnp.float32
+        )[:, None]  # [Bp, 1, P, T]
+        new_pool = []
+        for seg, size, (k_c, v_c) in zip(segments, seg_sizes, pool):
+            for i in range(size):
+                p_i = jax.tree_util.tree_map(lambda x, i=i: x[i], seg)
+                h, (k_l, v_l) = block_apply(
+                    spec, flags, p_i, h, bias, positions,
+                    kv_cache=(k_c[i], v_c[i]), cache_row_offsets=start,
+                    page_table=page_tables, page_size=page_size,
+                    attention_fn=attention_fn,
+                )
+                k_c = k_c.at[i].set(k_l)
+                v_c = v_c.at[i].set(v_l)
+            new_pool.append((k_c, v_c))
+
+    # first-step logits from the last REAL suffix token (right padding:
+    # per-row gather, not the shared last column)
+    last_idx = jnp.maximum(suffix_len - 1, 0)
+    h_last = h[jnp.arange(B), last_idx]  # [Bp, D]
+    h_normed = layer_norm(ln_f, h_last, spec.layer_norm_epsilon)
+    logits0 = project_logits(embed, spec, h_normed)  # [Bp, V]
+
+    rows = slot_ids.astype(jnp.int32)
+    valid_rows = (
+        jnp.arange(T)[None, :] < real_len[:, None]
+    ).astype(jnp.int32)
+    new_state = SlotState(
+        valid=state.valid.at[rows].set(valid_rows, mode="drop"),
+        offset=state.offset.at[rows].set(real_len, mode="drop"),
+        pos=state.pos.at[rows].set(real_len, mode="drop"),
+        generated=state.generated.at[rows].set(0, mode="drop"),
+        max_new=state.max_new.at[rows].set(
+            jnp.clip(max_new.astype(jnp.int32), 0, T - real_len),
+            mode="drop",
+        ),
+        active=state.active.at[rows].set(True, mode="drop"),
+        finished=state.finished.at[rows].set(False, mode="drop"),
+        logits=state.logits.at[rows].set(logits0, mode="drop"),
+        pages=state.pages.at[rows].set(
+            page_tables.astype(jnp.int32), mode="drop"
+        ),
     )
     return tuple(new_pool), new_state
 
@@ -766,6 +945,18 @@ def decode_step(
     )
     pos = state.pos[:, None]  # [S, 1] logical position of this token
     h = embed_tokens(embed, spec, tok[:, None], pos, compute_dtype)
+    paged = state.pages is not None
+    if paged:
+        # gate writes through the page table: non-emitting slots (free,
+        # finished, or harvested-awaiting-reuse) aim at the sentinel so
+        # their scatter drops — a harvested slot's pages may already
+        # belong to ANOTHER slot, so the old "write into your own row"
+        # harmlessness argument no longer holds
+        num_pages = pool[0][0].shape[1]
+        page_size = pool[0][0].shape[2]
+        pt_step = jnp.where(
+            emitted[:, None], state.pages, jnp.int32(num_pages)
+        )
     new_pool = []
     for seg, size, (k_c, v_c) in zip(segments, seg_sizes, pool):
         for i in range(size):
@@ -774,6 +965,8 @@ def decode_step(
                 spec, flags, p_i, h, bias, pos,
                 kv_cache=(k_c[i], v_c[i]),
                 cache_row_offsets=state.offset,
+                page_table=pt_step if paged else None,
+                page_size=page_size if paged else None,
                 attention_fn=attention_fn,
             )
             k_c = k_c.at[i].set(k_l)
@@ -792,5 +985,6 @@ def decode_step(
         active=state.active,
         finished=finished,
         logits=next_logits,
+        pages=state.pages,
     )
     return tuple(new_pool), new_state, tok, emitted, finished

@@ -281,6 +281,8 @@ def block_apply(
     cache_offset: Optional[jnp.ndarray] = None,
     attention_fn=attention_scores,
     cache_row_offsets: Optional[jnp.ndarray] = None,
+    page_table: Optional[jnp.ndarray] = None,
+    page_size: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """One transformer block on hidden states `h` [B, T, D].
 
@@ -297,6 +299,23 @@ def block_apply(
     T == 1 (one fresh token per row); rows whose offset is out of bounds
     are dropped (``mode="drop"``), which is how free/finished slots
     no-op. `cache_offset` is ignored in this mode.
+
+    `page_table` ([B, max_pages] int32) switches to the PAGED pool
+    layout: `kv_cache` is then the global page pool (k_pages, v_pages)
+    [num_pages, page_size, Hkv, hd] shared by all rows, and each row's
+    logical buffer position p lives at physical
+    ``(page_table[b, p // page_size], p % page_size)``. Fresh K/V for
+    token j of row b is scattered to logical position
+    ``cache_row_offsets[b] + j`` (T >= 1 is allowed here — the
+    prefix-suffix prefill path writes many tokens per row); entries whose
+    page id is out of bounds (the host allocator's sentinel) or whose
+    logical position exceeds the table extent are dropped, which is both
+    the filler-row warmup trick and the finished-slot write gate.
+    Attention gathers each row's K/V context page-by-page back into
+    logical order ([B, max_pages * page_size, Hkv, hd]) before scoring,
+    so `mask_bias` must be [B, 1, T, max_pages * page_size]; sentinel
+    pages gather clamped garbage that the (exactly-zero, see NEG_INF
+    softmax underflow) masked probabilities never read.
     """
     B, T, D = h.shape
     H, hd = spec.n_head, spec.head_dim
@@ -324,7 +343,54 @@ def block_apply(
         return jnp.repeat(t, H // Hkv, axis=2)
 
     new_cache = None
-    if kv_cache is not None:
+    if kv_cache is not None and page_table is not None:
+        if cache_row_offsets is None:
+            raise ValueError(
+                "paged cache writes need cache_row_offsets (per-row "
+                "logical start positions)"
+            )
+        if page_size is None or page_size <= 0:
+            raise ValueError(f"page_table given but page_size={page_size}")
+        k_cache, v_cache = kv_cache  # [num_pages, page_size, Hkv, hd]
+        num_pages = k_cache.shape[0]
+        max_pages = page_table.shape[1]
+        # logical buffer position of each fresh token, then page-id
+        # gather -> physical (page row, in-page offset) scatter
+        pos_buf = cache_row_offsets[:, None] + jnp.arange(T)[None, :]
+        page_idx = pos_buf // page_size
+        in_off = pos_buf % page_size
+        pids = jnp.where(
+            page_idx < max_pages,
+            jnp.take_along_axis(
+                page_table, jnp.minimum(page_idx, max_pages - 1), axis=1
+            ),
+            num_pages,  # out past the table: drop like a sentinel page
+        )
+        k_full = k_cache.at[pids, in_off].set(
+            k.astype(k_cache.dtype), mode="drop"
+        )
+        v_full = v_cache.at[pids, in_off].set(
+            v.astype(v_cache.dtype), mode="drop"
+        )
+        new_cache = (k_full, v_full)
+        # gather-by-page AFTER the scatter: within one prefill program a
+        # row may legitimately read pages another row just wrote (the
+        # radix cache admits same-batch prefix sharers against pages
+        # whose content materializes earlier in this same program)
+        ctx_pt = jnp.clip(page_table, 0, num_pages - 1)
+        k_ctx = k_full[ctx_pt].reshape(
+            B, max_pages * page_size, Hkv, hd
+        )
+        v_ctx = v_full[ctx_pt].reshape(
+            B, max_pages * page_size, Hkv, hd
+        )
+        a = attention_fn(
+            q,
+            expand_kv(k_ctx.astype(q.dtype)),
+            expand_kv(v_ctx.astype(q.dtype)),
+            mask_bias,
+        )
+    elif kv_cache is not None:
         k_cache, v_cache = kv_cache
         if cache_row_offsets is not None:
             if T != 1:
@@ -456,6 +522,20 @@ def init_kv_cache(
     """(k, v) cache buffers of shape [L, B, buffer_len, Hkv, hd] — compact
     KV-head form under grouped-query attention."""
     shape = (n_layers, batch, buffer_len, spec.kv_heads, spec.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_paged_kv_cache(
+    spec: ModelSpec,
+    n_layers: int,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.bfloat16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(k, v) page-pool buffers [L, num_pages, page_size, Hkv, hd]: one
+    global pool of fixed-size KV pages shared by every slot, addressed
+    through per-slot page tables (block_apply's paged mode)."""
+    shape = (n_layers, num_pages, page_size, spec.kv_heads, spec.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

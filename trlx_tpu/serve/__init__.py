@@ -21,7 +21,13 @@ Three layers (docs/source/serving.rst):
   persistent device-resident KV **slot pool**; at every decode step
   finished rows (EOS / per-request ``max_new_tokens``) are harvested,
   their slots freed immediately, and queued requests admitted via
-  bucketed prefill — short requests never wait for long ones;
+  bucketed prefill — short requests never wait for long ones. Under
+  ``serve.kv_layout: paged`` (default) the pool is block-granular
+  (fixed-size KV pages + per-slot page tables, host free-list
+  allocator) with radix-tree **prefix caching** (serve.paged):
+  admission reserves pages for each request's own length instead of
+  the worst case, and prompts sharing a committed prefix skip
+  re-prefilling it;
 - :class:`MicroBatcher` (serve.batcher, ``serve.scheduler: static``) —
   the PR-4 batch-to-completion micro-batcher kept for A/B: requests
   round up to a compiled shape class and coalesce until the bucket

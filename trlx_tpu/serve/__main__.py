@@ -63,6 +63,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="slot-pool size for --scheduler slots "
                         "(0 = largest compiled batch extent)")
+    p.add_argument("--kv-layout", choices=("paged", "contiguous"),
+                   default=None,
+                   help="slot-pool KV layout: 'paged' (default) = "
+                        "block-granular page pool + radix-tree prefix "
+                        "caching; 'contiguous' = one worst-case region "
+                        "per slot (the A/B fallback)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="tokens per KV page under --kv-layout paged "
+                        "(also the prefix-cache sharing granularity)")
+    p.add_argument("--pages", type=int, default=None,
+                   help="page-pool size under --kv-layout paged "
+                        "(0 = slots x pages-per-slot capacity parity)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip lattice precompilation at startup (first "
                         "request per bucket then pays the compile)")
@@ -85,7 +97,10 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("request_timeout", "request_timeout"),
                        ("stall_timeout", "stall_timeout"),
                        ("scheduler", "scheduler"),
-                       ("slots", "slots")):
+                       ("slots", "slots"),
+                       ("kv_layout", "kv_layout"),
+                       ("page_size", "page_size"),
+                       ("pages", "pages")):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, attr, value)

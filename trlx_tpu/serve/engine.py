@@ -80,7 +80,28 @@ class ServeConfig:
         sizes it to the largest compiled batch extent — capacity parity
         with the static path. Pool HBM is
         ``2 * n_layer * slots * max(prompt+gen) * kv_heads * head_dim``
-        cache-dtype elements.
+        cache-dtype elements under the contiguous layout, or
+        ``2 * n_layer * pages * page_size * kv_heads * head_dim`` paged.
+    :param kv_layout: ``"paged"`` (default) backs the slot pool with a
+        block-granular page pool + per-slot page tables and radix-tree
+        prefix caching (requests sharing a committed prompt prefix skip
+        re-prefilling it); ``"contiguous"`` keeps the PR-5 one-region-
+        per-slot layout (the A/B fallback — no prefix sharing, HBM
+        bounded by slots x worst-case length).
+    :param page_size: tokens per KV page under ``kv_layout: paged``
+        (clamped to the slot buffer length). Smaller pages waste less on
+        the last partial page and match shorter shared prefixes; larger
+        pages mean fewer table entries and bigger contiguous reads. Also
+        the prefix-cache granularity: only whole committed pages are
+        shared.
+    :param pages: page-pool size under ``kv_layout: paged``; 0 (default)
+        sizes it to ``slots * ceil(buffer_len / page_size)`` — capacity
+        parity with the contiguous pool. Size it DOWN (or slots UP) to
+        bank on real traffic being shorter than worst case: admission
+        reserves only each request's own ``ceil((prompt + max_new) /
+        page_size)`` pages, so mixed-length traffic packs more live
+        slots into the same HBM (docs/source/serving.rst has the
+        pages-per-GB formula).
     """
 
     buckets: List[List[int]] = field(
@@ -95,6 +116,9 @@ class ServeConfig:
     seed: int = 0
     scheduler: str = "slots"
     slots: int = 0
+    kv_layout: str = "paged"
+    page_size: int = 64
+    pages: int = 0
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -165,6 +189,19 @@ class InferenceEngine:
         if self.serve.slots < 0:
             raise ValueError(
                 f"serve.slots={self.serve.slots} must be >= 0 (0 = auto)"
+            )
+        if self.serve.kv_layout not in ("paged", "contiguous"):
+            raise ValueError(
+                f"serve.kv_layout '{self.serve.kv_layout}' is not one of: "
+                f"paged, contiguous"
+            )
+        if self.serve.page_size < 1:
+            raise ValueError(
+                f"serve.page_size={self.serve.page_size} must be >= 1"
+            )
+        if self.serve.pages < 0:
+            raise ValueError(
+                f"serve.pages={self.serve.pages} must be >= 0 (0 = auto)"
             )
         self.buckets = _normalize_buckets(self.serve.buckets)
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)
@@ -401,6 +438,30 @@ class InferenceEngine:
         bucket needs (bucket validation already pinned it under
         n_positions)."""
         return max(p + g for _, p, g in self.buckets)
+
+    # -- paged-pool lattice (serve.kv_layout: paged) ---------------------- #
+
+    def page_size_tokens(self) -> int:
+        """Effective KV page size: ``serve.page_size`` clamped to the
+        slot buffer length (a page larger than the longest request is
+        just the contiguous layout with extra steps)."""
+        return min(self.serve.page_size, self.slot_buffer_len())
+
+    def pages_per_slot(self) -> int:
+        """Page-table width: pages covering one slot's full extent."""
+        ps = self.page_size_tokens()
+        return -(-self.slot_buffer_len() // ps)
+
+    def page_count(self) -> int:
+        """Page-pool size: ``serve.pages``, or slots x pages-per-slot
+        (capacity parity with the contiguous layout) when 0."""
+        return self.serve.pages or self.slot_count() * self.pages_per_slot()
+
+    def request_page_need(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case pages one request reserves at admission (prefix
+        hits only reduce it)."""
+        ps = self.page_size_tokens()
+        return -(-(prompt_len + max_new_tokens) // ps)
 
     # -- decode ---------------------------------------------------------- #
 

@@ -51,6 +51,11 @@ _SERVE_COUNTERS = (
     "serve/admissions",
     "serve/evictions",
     "serve/preempted_steps",
+    # paged-KV family (trlx_tpu.serve.paged): prompt tokens whose prefill
+    # was skipped via radix prefix hits, cached pages LRU-evicted under
+    # allocation pressure
+    "serve/prefix_tokens_saved",
+    "serve/evicted_pages",
 )
 
 
@@ -90,6 +95,9 @@ class _Handler(BaseHTTPRequestHandler):
             if free is not None:
                 body["slots"] = srv.batcher.runtime.num_slots
                 body["free_slots"] = free()
+            pool_stats = getattr(srv.batcher, "pool_stats", None)
+            if pool_stats is not None:
+                body["kv"] = pool_stats()
             self._json(200, body)
         elif self.path == "/metrics":
             self._json(200, telemetry.summary())
@@ -218,6 +226,13 @@ class InferenceServer:
         telemetry.predeclare(_SERVE_COUNTERS)
         if self.engine.serve.scheduler == "slots":
             telemetry.set_gauge("serve/slot_occupancy", 0.0)
+            cache = getattr(self.batcher, "cache", None)
+            if cache is not None:  # paged pool health, scraped from 0
+                telemetry.set_gauge(
+                    "serve/pages_free", cache.free_pages()
+                )
+                telemetry.set_gauge("serve/prefix_hit_rate", 0.0)
+                telemetry.set_gauge("serve/pages_per_request_p95", 0.0)
         if warmup and not self.warmed:
             if self.engine.serve.scheduler == "slots":
                 latencies = self.batcher.warmup()

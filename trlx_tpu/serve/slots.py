@@ -8,22 +8,32 @@ level instead (Orca, Yu et al., OSDI '22), over a persistent
 device-resident KV **slot pool** (the static-shape analogue of vLLM's
 paged KV blocks, Kwon et al., SOSP '23):
 
-- :class:`SlotPoolRuntime` owns the pool + per-slot lanes and the two
+- :class:`SlotPoolRuntime` owns the pool + per-slot lanes and the
   AOT-compiled device primitives (trlx_tpu.models.generation):
   ``prefill_into_slots`` — one executable per (batch, prompt_len)
-  admission bucket — and ``decode_step`` — ONE executable for all slots.
-  Pool and state are donated on accelerators, so a step updates the pool
-  in place; warmup runs every prefill bucket against the live pool with
-  out-of-bounds sentinel slot ids (scatters ``mode="drop"`` — compiles
-  the shape, touches nothing), then one decode step. Steady state is
-  first-compiles only: ``compile/recompiles == 0`` stays the serving
-  invariant.
+  admission bucket (two under the paged layout: plain + the
+  ``prefill_suffix`` prefix-context variant) — and ``decode_step`` —
+  ONE executable for all slots. Pool and state are donated on
+  accelerators, so a step updates the pool in place; warmup runs every
+  prefill bucket against the live pool with out-of-bounds sentinel slot
+  ids (scatters ``mode="drop"`` — compiles the shape, touches nothing),
+  then one decode step. Steady state is first-compiles only:
+  ``compile/recompiles == 0`` stays the serving invariant.
+- Under ``serve.kv_layout: paged`` (the default) the pool is
+  block-granular: fixed-size KV pages shared by all slots, addressed
+  through per-slot page tables, with a host free-list allocator and a
+  radix-tree prefix cache (trlx_tpu.serve.paged) — admission reserves
+  ``ceil((prompt + max_new) / page_size)`` pages instead of the
+  worst-case buffer, prompts sharing committed prefixes skip
+  re-prefilling them, and page exhaustion QUEUES requests (never
+  fails). ``serve.kv_layout: contiguous`` keeps the PR-5
+  one-region-per-slot pool as the A/B fallback.
 - :class:`SlotScheduler` runs the host loop: at every step boundary it
   **harvests** finished rows (EOS, or the request's own
   ``max_new_tokens`` — not the bucket's gen extent), frees their slots
-  immediately, and **admits** queued requests into free slots via
-  bucketed prefill. Short requests no longer wait for long ones; filler
-  rows become free slots; steady-state **slot occupancy**
+  (and pages) immediately, and **admits** queued requests into free
+  slots via bucketed prefill. Short requests no longer wait for long
+  ones; filler rows become free slots; steady-state **slot occupancy**
   (``serve/slot_occupancy``) replaces ``batch_fill_ratio`` as the
   utilization signal.
 
@@ -36,11 +46,15 @@ lanes, and keeps serving; a poisoned admission fails only its batch.
 
 Metrics (trlx_tpu.telemetry): ``serve/admissions`` / ``serve/evictions``
 / ``serve/preempted_steps`` counters, ``serve/slot_occupancy`` gauge,
-plus the shared ``serve/requests|responses|rejected|request_errors|
-generated_tokens`` family and ``serve/request_latency`` histogram. The
-old batch-to-completion path stays available as ``serve.scheduler:
-static`` for A/B (bench.py replays the same mixed-length trace against
-both).
+the paged-pool family (``serve/prefix_tokens_saved`` /
+``serve/evicted_pages`` counters, ``serve/pages_free`` /
+``serve/prefix_hit_rate`` / ``serve/pages_per_request_p95`` gauges,
+``serve/pages_per_request`` histogram), plus the shared
+``serve/requests|responses|rejected|request_errors|generated_tokens``
+family and ``serve/request_latency`` histogram. The old
+batch-to-completion path stays available as ``serve.scheduler: static``
+for A/B (bench.py replays the same mixed-length trace against both
+schedulers and both KV layouts).
 """
 
 import threading
@@ -66,6 +80,7 @@ class SlotPoolRuntime:
 
         from trlx_tpu.models.generation import (
             _segments_of,
+            init_page_pool,
             init_slot_pool,
             init_slot_state,
         )
@@ -73,25 +88,41 @@ class SlotPoolRuntime:
         self.engine = engine
         self.num_slots = engine.slot_count() if num_slots is None \
             else int(num_slots)
-        self.buffer_len = engine.slot_buffer_len()
+        self.kv_layout = engine.serve.kv_layout
         self._segments, self._seg_sizes = _segments_of(engine.blocks)
         self._vocab = engine.spec.vocab_size
         # CPU has no buffer donation; donating there only prints warnings
         self._donate = jax.default_backend() != "cpu"
-        self.pool = init_slot_pool(
-            engine.spec, self._seg_sizes, self.num_slots, self.buffer_len
-        )
+        if self.kv_layout == "paged":
+            self.page_size = engine.page_size_tokens()
+            self.max_pages = engine.pages_per_slot()
+            self.num_pages = engine.page_count()
+            # logical per-slot extent rounds UP to whole pages
+            self.buffer_len = self.max_pages * self.page_size
+            self.pool = init_page_pool(
+                engine.spec, self._seg_sizes, self.num_pages,
+                self.page_size,
+            )
+        else:
+            self.page_size = self.max_pages = self.num_pages = 0
+            self.buffer_len = engine.slot_buffer_len()
+            self.pool = init_slot_pool(
+                engine.spec, self._seg_sizes, self.num_slots,
+                self.buffer_len,
+            )
         self.state = init_slot_state(
-            self.num_slots, self.buffer_len, self._vocab
+            self.num_slots, self.buffer_len, self._vocab,
+            max_pages=self.max_pages or None,
         )
-        self._prefill_fns = {}  # (Bp, P) -> aot_jit'd closure
+        self._prefill_fns = {}  # (Bp, P[, suffix]) -> aot_jit'd closure
         self._step_fn = None
         self.warmed = False
 
     # -- compiled closures ----------------------------------------------- #
 
-    def _prefill_fn(self, bucket):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, bucket, suffix: bool = False):
+        key = (*bucket, suffix) if self.kv_layout == "paged" else bucket
+        fn = self._prefill_fns.get(key)
         if fn is None:
             from trlx_tpu.models.generation import prefill_into_slots
             from trlx_tpu.utils.aotjit import aot_jit
@@ -99,14 +130,27 @@ class SlotPoolRuntime:
             spec = self.engine.spec
             compute = self.engine._compute_dtype
 
-            def run(blocks, embed, ln_f, pool, state, tokens, mask,
-                    slot_ids, max_new):
-                return prefill_into_slots(
-                    spec, blocks, embed, ln_f, pool, state, tokens, mask,
-                    slot_ids, max_new, compute_dtype=compute,
-                )
+            if self.kv_layout == "paged":
+                ps = self.page_size
 
-            fn = self._prefill_fns[bucket] = aot_jit(
+                def run(blocks, embed, ln_f, pool, state, tokens, mask,
+                        slot_ids, max_new, page_tables, start):
+                    return prefill_into_slots(
+                        spec, blocks, embed, ln_f, pool, state, tokens,
+                        mask, slot_ids, max_new, compute_dtype=compute,
+                        page_tables=page_tables, page_size=ps,
+                        start=start, prefix_context=suffix,
+                    )
+            else:
+
+                def run(blocks, embed, ln_f, pool, state, tokens, mask,
+                        slot_ids, max_new):
+                    return prefill_into_slots(
+                        spec, blocks, embed, ln_f, pool, state, tokens,
+                        mask, slot_ids, max_new, compute_dtype=compute,
+                    )
+
+            fn = self._prefill_fns[key] = aot_jit(
                 run, donate_argnums=(3, 4) if self._donate else (),
             )
         return fn
@@ -133,28 +177,39 @@ class SlotPoolRuntime:
 
     # -- spans ------------------------------------------------------------ #
 
-    def prefill_span(self, bucket) -> str:
+    def prefill_span(self, bucket, suffix: bool = False) -> str:
         Bp, P = bucket
-        return f"serve/prefill_b{Bp}p{P}"
+        return f"serve/prefill{'_sfx' if suffix else ''}_b{Bp}p{P}"
 
     STEP_SPAN = "serve/slot_step"
 
     # -- device calls ------------------------------------------------------ #
 
     def prefill(self, bucket, tokens: np.ndarray, mask: np.ndarray,
-                slot_ids, max_new) -> None:
+                slot_ids, max_new, page_tables=None, start=None,
+                suffix: bool = False) -> None:
         """Admit one prompt bucket into the pool (filler rows carry the
-        out-of-bounds sentinel and are dropped on device)."""
+        out-of-bounds sentinel and are dropped on device). Paged layout:
+        ``page_tables`` [Bp, max_pages] maps each row's logical pages
+        (sentinel-padded), ``start`` is its committed prefix length, and
+        ``suffix=True`` selects the prefix-context (``prefill_suffix``)
+        executable; tokens/mask are right-padded there."""
         e = self.engine
-        fn = self._prefill_fn(bucket)
-        with telemetry.span(self.prefill_span(bucket)):
-            self.pool, self.state = fn(
-                e.blocks, e.embed, e.ln_f, self.pool, self.state,
-                np.ascontiguousarray(tokens, np.int32),
-                np.ascontiguousarray(mask, np.int32),
-                np.asarray(slot_ids, np.int32),
-                np.asarray(max_new, np.int32),
-            )
+        fn = self._prefill_fn(bucket, suffix)
+        args = [
+            e.blocks, e.embed, e.ln_f, self.pool, self.state,
+            np.ascontiguousarray(tokens, np.int32),
+            np.ascontiguousarray(mask, np.int32),
+            np.asarray(slot_ids, np.int32),
+            np.asarray(max_new, np.int32),
+        ]
+        if self.kv_layout == "paged":
+            args += [
+                np.ascontiguousarray(page_tables, np.int32),
+                np.asarray(start, np.int32),
+            ]
+        with telemetry.span(self.prefill_span(bucket, suffix)):
+            self.pool, self.state = fn(*args)
 
     def step(self, seed: int):
         """One decode step for every slot; returns host-side
@@ -171,18 +226,42 @@ class SlotPoolRuntime:
             return jax.device_get((tok, emitted, finished))
 
     def reset_lanes(self) -> None:
-        """Fresh all-free per-slot lanes AND pool buffers — the
-        poisoned-step containment path. Rebuilding the pool matters under
-        donation: a program that failed mid-execution may have consumed
-        the donated buffers, so the old arrays cannot be trusted."""
-        from trlx_tpu.models.generation import init_slot_pool, init_slot_state
+        """Fresh all-free per-slot lanes, REUSING the pool buffers — the
+        poisoned-step containment path. Zeroed lanes (valid/active/pages)
+        already gate every read of the big KV buffers, so their stale
+        contents are harmless and keeping them avoids transiently holding
+        2x the pool in HBM mid-reset. The one case the old arrays cannot
+        be trusted is donation: a program that failed mid-execution may
+        have CONSUMED the donated buffers — detected per-leaf via
+        ``is_deleted()``, and only then is the pool reallocated."""
+        import jax
 
-        self.pool = init_slot_pool(
-            self.engine.spec, self._seg_sizes, self.num_slots,
-            self.buffer_len,
+        from trlx_tpu.models.generation import (
+            init_page_pool,
+            init_slot_pool,
+            init_slot_state,
         )
+
+        def consumed(leaf):
+            try:
+                return leaf.is_deleted()
+            except Exception:
+                return True  # uninspectable -> rebuild, the safe side
+
+        if any(consumed(x) for x in jax.tree_util.tree_leaves(self.pool)):
+            if self.kv_layout == "paged":
+                self.pool = init_page_pool(
+                    self.engine.spec, self._seg_sizes, self.num_pages,
+                    self.page_size,
+                )
+            else:
+                self.pool = init_slot_pool(
+                    self.engine.spec, self._seg_sizes, self.num_slots,
+                    self.buffer_len,
+                )
         self.state = init_slot_state(
-            self.num_slots, self.buffer_len, self._vocab
+            self.num_slots, self.buffer_len, self._vocab,
+            max_pages=self.max_pages or None,
         )
 
     # -- warmup ------------------------------------------------------------ #
@@ -195,24 +274,37 @@ class SlotPoolRuntime:
         first-call seconds}."""
         pad = self.engine.pad_token_id
         latencies = {}
+        paged = self.kv_layout == "paged"
+        variants = (False, True) if paged else (False,)
         for P, extents in self.engine.prompt_classes():
             for Bp in extents:
-                tokens = np.full((Bp, P), pad, np.int32)
-                tokens[:, -1] = 0
-                mask = np.zeros((Bp, P), np.int32)
-                mask[:, -1] = 1
-                self.prefill(
-                    (Bp, P), tokens, mask,
-                    np.full((Bp,), self.num_slots, np.int32),
-                    np.ones((Bp,), np.int32),
-                )
+                for suffix in variants:
+                    tokens = np.full((Bp, P), pad, np.int32)
+                    mask = np.zeros((Bp, P), np.int32)
+                    if paged:  # right-padded: one real token FIRST
+                        tokens[:, 0] = 0
+                        mask[:, 0] = 1
+                    else:
+                        tokens[:, -1] = 0
+                        mask[:, -1] = 1
+                    self.prefill(
+                        (Bp, P), tokens, mask,
+                        np.full((Bp,), self.num_slots, np.int32),
+                        np.ones((Bp,), np.int32),
+                        page_tables=np.full(
+                            (Bp, self.max_pages), self.num_pages, np.int32
+                        ) if paged else None,
+                        start=np.zeros((Bp,), np.int32) if paged else None,
+                        suffix=suffix,
+                    )
         self.step(0)
         tel = telemetry.current()
         if tel is not None:
             spans = [
-                self.prefill_span((Bp, P))
+                self.prefill_span((Bp, P), suffix)
                 for P, extents in self.engine.prompt_classes()
                 for Bp in extents
+                for suffix in variants
             ] + [self.STEP_SPAN]
             for span in spans:
                 hist = tel.registry.hists.get(f"time/{span}")
@@ -226,13 +318,19 @@ class SlotPoolRuntime:
 
 
 class _LiveSlot:
-    """Host bookkeeping for one occupied slot."""
+    """Host bookkeeping for one occupied slot. ``pages`` is the slot's
+    full page-table content under the paged layout (matched prefix pages
+    first — every entry holds one allocator reference released at
+    harvest); ``committed`` the pages this admission inserted into the
+    radix tree (the rollback handle for a failed prefill)."""
 
-    __slots__ = ("request", "tokens")
+    __slots__ = ("request", "tokens", "pages", "committed")
 
-    def __init__(self, request: Request):
+    def __init__(self, request: Request, pages=None, committed=None):
         self.request = request
         self.tokens: List[int] = []
+        self.pages: List[int] = pages or []
+        self.committed: List[int] = committed or []
 
 
 class SlotScheduler:
@@ -246,11 +344,22 @@ class SlotScheduler:
 
     def __init__(self, engine, max_queue: Optional[int] = None,
                  run_supervisor=None, slots: Optional[int] = None):
+        from trlx_tpu.serve.paged import RadixCache
+
         self.engine = engine
         cfg = engine.serve
         self.max_queue = cfg.max_queue if max_queue is None else max_queue
         self.run_supervisor = run_supervisor
         self.runtime = SlotPoolRuntime(engine, num_slots=slots)
+        #: host paged-KV broker (allocator + radix prefix cache); None
+        #: under the contiguous layout
+        self.cache: Optional[RadixCache] = None
+        if self.runtime.kv_layout == "paged":
+            self.cache = RadixCache(
+                self.runtime.num_pages, self.runtime.page_size
+            )
+        self._prompt_tokens_total = 0  # prefix hit-rate denominators
+        self._prefix_tokens_saved = 0
         self._queue = deque()
         self._cond = threading.Condition()
         self._stop = threading.Event()
@@ -258,7 +367,7 @@ class SlotScheduler:
         self._free = list(range(self.runtime.num_slots))
         self._live: Dict[int, _LiveSlot] = {}
         self._step_counter = 0
-        self._starved = False  # queue waited while no slot was free
+        self._starved = False  # queue waited while no slot/page was free
         #: (event, slot, request) ring — "admit"/"free"; the e2e tests
         #: read it to prove a freed slot was reused mid-decode
         self.events = deque(maxlen=4096)
@@ -324,6 +433,17 @@ class SlotScheduler:
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
+        if self.cache is not None:
+            need = self.engine.request_page_need(
+                len(tokens), max_new_tokens
+            )
+            if need > self.runtime.num_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages worst-case but the "
+                    f"pool holds {self.runtime.num_pages}; raise "
+                    f"serve.pages (or serve.page_size) — queueing could "
+                    f"never admit it"
+                )
         req = Request(list(tokens), max_new_tokens, shape, seed=seed)
         with self._cond:
             if len(self._queue) >= self.max_queue:
@@ -347,8 +467,9 @@ class SlotScheduler:
     def _admit(self) -> None:
         """Move queued requests into free slots, one prompt-class bucket
         at a time (FIFO head's class first). Sets ``_starved`` when
-        requests are left waiting with no free slot — the next step then
-        counts as ``serve/preempted_steps``."""
+        requests are left waiting with no free slot (or, paged, no
+        obtainable page) — the next step then counts as
+        ``serve/preempted_steps``."""
         while True:
             with self._cond:
                 self._starved = bool(self._queue) and not self._free
@@ -364,21 +485,35 @@ class SlotScheduler:
                 for r in batch:
                     self._queue.remove(r)
                 telemetry.set_gauge("serve/queue_depth", len(self._queue))
+            admitted_all = True
             with supervisor.phase("serve_admit"):
                 try:
                     chaos.maybe_inject("serve_admit")
-                    self._prefill_batch(batch, P, extents)
+                    admitted_all = self._prefill_batch(batch, P, extents)
                 except Exception as e:
-                    # a poisoned admission fails ITS requests; the pool
-                    # lanes were only touched if the device call ran, and
-                    # dropped-sentinel scatters cannot corrupt live slots
+                    # a poisoned admission fails ITS requests (paged:
+                    # page-starved ones were already re-queued and
+                    # removed from `batch`, so they are NOT failed); the
+                    # pool lanes were only touched if the device call
+                    # ran, and dropped-sentinel scatters cannot corrupt
+                    # live slots
                     telemetry.inc("serve/request_errors", len(batch))
                     for r in batch:
                         r.error = e
                         r.done.set()
                 supervisor.beat()
+            if not admitted_all:
+                # page pool exhausted mid-batch: requests stay QUEUED
+                # (never crashed/failed) until harvests return pages —
+                # keep stepping the live slots instead of spinning here
+                self._starved = True
+                return
 
-    def _prefill_batch(self, batch: List[Request], P: int, extents) -> None:
+    def _prefill_batch(self, batch: List[Request], P: int, extents) -> bool:
+        """Prefill one admission batch; returns False when the paged
+        allocator ran dry and part of the batch went back to the queue."""
+        if self.cache is not None:
+            return self._prefill_batch_paged(batch, P, extents)
         Bp = next(b for b in extents if b >= len(batch))
         slots = [self._free.pop() for _ in batch]
         sentinel = self.runtime.num_slots
@@ -397,6 +532,127 @@ class SlotScheduler:
             self.events.append(("admit", s, r))
         telemetry.inc("serve/admissions", len(batch))
         telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
+        return True
+
+    def _prefill_batch_paged(self, batch: List[Request], P: int,
+                             extents) -> bool:
+        """Paged admission: radix-match each prompt, reserve pages for
+        the unmatched suffix + decode budget, map hit pages copy-free
+        into the page table, and prefill ONLY the suffix. Requests the
+        allocator cannot cover (even after LRU eviction) go back to the
+        queue head in order — exhaustion queues, never crashes."""
+        ps = self.runtime.page_size
+        chaos.maybe_inject("serve_prefix_match")
+        plans = []  # (request, toks, matched, pages, committed)
+        deferred: List[Request] = []
+        for i, r in enumerate(batch):
+            toks = r.tokens[-P:]
+            matched = self.cache.match(toks)
+            need = self.engine.request_page_need(
+                len(toks), r.max_new_tokens
+            ) - len(matched)
+            fresh = self.cache.alloc(need)
+            if fresh is None:
+                self.cache.release_all(matched)
+                deferred = batch[i:]
+                break
+            pages = matched + fresh
+            committed = self.cache.commit(toks, pages)
+            plans.append((r, toks, matched, pages, committed))
+        if deferred:
+            with self._cond:
+                for r in reversed(deferred):
+                    self._queue.appendleft(r)
+                telemetry.set_gauge("serve/queue_depth", len(self._queue))
+            # the _admit exception handler must not fail re-queued rows
+            batch[:] = [p[0] for p in plans]
+        if not plans:
+            telemetry.set_gauge(
+                "serve/pages_free", self.cache.free_pages()
+            )
+            return False
+
+        Bp = next(b for b in extents if b >= len(plans))
+        slots = [self._free.pop() for _ in plans]
+        pad = self.engine.pad_token_id
+        tokens = np.full((Bp, P), pad, np.int32)
+        mask = np.zeros((Bp, P), np.int32)
+        page_tables = np.full(
+            (Bp, self.runtime.max_pages), self.runtime.num_pages, np.int32
+        )
+        starts = np.zeros((Bp,), np.int32)
+        max_new = np.ones((Bp,), np.int32)
+        slot_ids = np.full((Bp,), self.runtime.num_slots, np.int32)
+        for j, ((r, toks, matched, pages, _), s) in enumerate(
+            zip(plans, slots)
+        ):
+            start = len(matched) * ps
+            suf = toks[start:]
+            tokens[j, :len(suf)] = suf  # right-padded suffix
+            mask[j, :len(suf)] = 1
+            page_tables[j, :len(pages)] = pages
+            starts[j] = start
+            max_new[j] = r.max_new_tokens
+            slot_ids[j] = s
+        try:
+            self.runtime.prefill(
+                (Bp, P), tokens, mask, slot_ids, max_new,
+                page_tables=page_tables, start=starts,
+                suffix=bool(starts.any()),
+            )
+        except Exception:
+            self._free.extend(slots)  # nothing was admitted
+            for _, _, _, _, committed in reversed(plans):
+                self.cache.rollback(committed)  # content never landed
+            for _, _, _, pages, _ in plans:
+                self.cache.release_all(pages)
+            raise
+        saved = 0
+        for (r, toks, matched, pages, committed), s in zip(plans, slots):
+            self._live[s] = _LiveSlot(r, pages=pages, committed=committed)
+            self.events.append(("admit", s, r))
+            saved += len(matched) * ps
+            self._prompt_tokens_total += len(toks)
+            telemetry.observe("serve/pages_per_request", len(pages))
+        self._prefix_tokens_saved += saved
+        if saved:
+            telemetry.inc("serve/prefix_tokens_saved", saved)
+        telemetry.inc("serve/admissions", len(plans))
+        telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
+        self._emit_pool_gauges()
+        return not deferred
+
+    def _hit_rate(self) -> float:
+        return self._prefix_tokens_saved / max(self._prompt_tokens_total, 1)
+
+    def _emit_pool_gauges(self) -> None:
+        telemetry.set_gauge("serve/pages_free", self.cache.free_pages())
+        telemetry.set_gauge("serve/prefix_hit_rate", self._hit_rate())
+        tel = telemetry.current()
+        if tel is not None:
+            hist = tel.registry.hists.get("serve/pages_per_request")
+            if hist is not None:
+                telemetry.set_gauge(
+                    "serve/pages_per_request_p95", hist.quantile(0.95)
+                )
+
+    def pool_stats(self) -> Dict:
+        """Host view of the KV pool — the /healthz ``kv`` block."""
+        stats = {
+            "kv_layout": self.runtime.kv_layout,
+            "slots": self.runtime.num_slots,
+        }
+        if self.cache is not None:
+            stats.update(
+                page_size=self.runtime.page_size,
+                pages_total=self.runtime.num_pages,
+                pages_free=self.cache.free_pages(),
+                pages_cached=self.cache.cached_pages(),
+                evicted_pages=self.cache.evicted_pages,
+                prefix_hit_rate=round(self._hit_rate(), 4),
+                prefix_tokens_saved=self._prefix_tokens_saved,
+            )
+        return stats
 
     def _step(self) -> None:
         with supervisor.phase("serve_decode"):
@@ -422,6 +678,14 @@ class SlotScheduler:
                 req.done.set()
                 del self._live[slot]
                 self._free.append(slot)
+                if self.cache is not None:
+                    # committed (trie-owned) pages stay cached at
+                    # refcount 0 — hit-able until LRU eviction; the rest
+                    # return to the free list
+                    self.cache.release_all(live.pages)
+                    telemetry.set_gauge(
+                        "serve/pages_free", self.cache.free_pages()
+                    )
                 self.events.append(("free", slot, req))
                 telemetry.inc("serve/evictions")
                 telemetry.inc("serve/responses")
@@ -443,10 +707,24 @@ class SlotScheduler:
         self._live.clear()
         self._free = list(range(self.runtime.num_slots))
         telemetry.inc("serve/request_errors", len(live))
+        # contain FIRST, signal last: a waiter released by done.set()
+        # must observe the post-reset pool/cache, not a torn intermediate
+        self.runtime.reset_lanes()
+        if self.cache is not None:
+            # the lanes are gone, so every page mapping (and every cached
+            # prefix whose content can no longer be trusted after a
+            # poisoned step) resets with them
+            from trlx_tpu.serve.paged import RadixCache
+
+            self.cache = RadixCache(
+                self.runtime.num_pages, self.runtime.page_size
+            )
+            telemetry.set_gauge(
+                "serve/pages_free", self.cache.free_pages()
+            )
         for s in live:
             s.request.error = error
             s.request.done.set()
-        self.runtime.reset_lanes()
         telemetry.set_gauge("serve/slot_occupancy", 0.0)
 
     def _run(self) -> None:

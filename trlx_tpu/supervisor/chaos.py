@@ -24,8 +24,12 @@ A schedule is a ``;``-separated list of rules::
   the slot scheduler's ``serve_admit`` phase after an admission batch is
   selected, before its prefill dispatch — a ``hang`` makes a wedged
   admission an attributable stall, an ``exc`` fails just that batch),
-  and ``serve_request`` (fired at request-handler entry — an ``exc``
-  surfaces as the HTTP 500 error path).
+  ``serve_prefix_match`` (fired inside the same ``serve_admit`` phase at
+  the top of the slot scheduler's PAGED admission, before the radix
+  prefix walk / page allocation — a ``hang`` proves a wedged
+  prefix-match is a watchdog-attributable ``serve_admit`` stall, not
+  silence), and ``serve_request`` (fired at request-handler entry — an
+  ``exc`` surfaces as the HTTP 500 error path).
 - ``action``: ``hang`` (block ``param`` seconds, default 3600 — a
   bounded seam times out, the watchdog sees everything else), ``exc``
   (raise :class:`ChaosError`), ``slow`` (sleep ``param`` seconds, default
