@@ -54,14 +54,19 @@ chaos:
 # prefix cache (test_paged.py: allocator/radix semantics, greedy-parity
 # sweep across page sizes, prefix-hit prefill skipping, exhaustion
 # queue-not-crash, serve_prefix_match chaos drill, pool health on
-# /healthz, contiguous fallback), HTTP endpoint parity e2e, and the
-# serve_decode/serve_request containment paths. Part of the non-slow
+# /healthz, contiguous fallback), HTTP endpoint parity e2e, the
+# serve_decode/serve_request containment paths, and the
+# request-lifecycle observability layer (test_request_trace.py:
+# RequestTrace/TTFT/ITL semantics, Perfetto span export validity,
+# Prometheus /metrics exposition, /debug/state schema, flight-recorder
+# dumps on poisoned steps and watchdog stalls). Part of the non-slow
 # tier-1 set; this target runs just them. The slow-marked soak
 # (hundreds of mixed-length requests, zero recompiles, zero slot leaks)
 # is opt-in via `make serve-soak`.
 serve:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
-		tests/test_slots.py tests/test_paged.py -q -m 'not slow'
+		tests/test_slots.py tests/test_paged.py \
+		tests/test_request_trace.py -q -m 'not slow'
 
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \

@@ -1045,7 +1045,13 @@ def bench_serving(n_requests=96, trace_seed=17):
     system prompt's pages on first sight; every later request maps them
     copy-free and prefills only its tail —
     ``serve_prefix_prefill_tokens_saved`` counts the skipped prefill
-    tokens (the acceptance bar is >= 50% of all prompt tokens)."""
+    tokens (the acceptance bar is >= 50% of all prompt tokens).
+
+    Every leg also reports the request-lifecycle SLO metrics
+    (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
+    ``serve_itl_p50/p95_ms``, and the paged leg runs an extra
+    tracing-OFF pass first so ``serve_trace_overhead_frac`` is the
+    measured tok/s cost of per-request tracing (bar: < 5%)."""
     import jax
 
     from trlx_tpu import telemetry
@@ -1099,6 +1105,12 @@ def bench_serving(n_requests=96, trace_seed=17):
         for _ in range(n_requests)
     ]
 
+    def pct_ms(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)] * 1e3
+
     def replay(driver, reqs_trace=None):
         t0 = time.perf_counter()
         reqs = [
@@ -1109,39 +1121,60 @@ def bench_serving(n_requests=96, trace_seed=17):
             r.wait(timeout=600.0)
         dt = time.perf_counter() - t0
         tokens_out = sum(len(r.result) for r in reqs)
-        lat = sorted(r.latency_s for r in reqs)
-        p50 = lat[len(lat) // 2]
-        p95 = lat[min(int(0.95 * (len(lat) - 1)), len(lat) - 1)]
-        return tokens_out / dt, p50 * 1e3, p95 * 1e3
+        lat = [r.latency_s for r in reqs]
+        # SLO metrics off the per-request lifecycle traces (None when
+        # tracing is off — the A/B baseline run reports zeros)
+        ttfts = [r.trace.ttft() for r in reqs
+                 if r.trace is not None and r.trace.first_token]
+        itls = [r.trace.itl_mean() for r in reqs
+                if r.trace is not None and r.trace.itl_count]
+        return {
+            "tok_s": tokens_out / dt,
+            "p50": pct_ms(lat, 0.50), "p95": pct_ms(lat, 0.95),
+            "ttft_p50": pct_ms(ttfts, 0.50),
+            "ttft_p95": pct_ms(ttfts, 0.95),
+            "itl_p50": pct_ms(itls, 0.50), "itl_p95": pct_ms(itls, 0.95),
+        }
 
     def replay_slots(reqs_trace=None):
         scheduler = SlotScheduler(engine)
         scheduler.warmup()
         scheduler.start()
         try:
-            return (*replay(scheduler, reqs_trace), scheduler.pool_stats())
+            return replay(scheduler, reqs_trace), scheduler.pool_stats()
         finally:
             scheduler.stop()
 
     # static first (its warmup compiles the one-shot bucket lattice)
     engine.warmup()
-    static = MicroBatcher(engine).start()
+    static_drv = MicroBatcher(engine).start()
     try:
-        static_tok_s, static_p50, static_p95 = replay(static)
+        static = replay(static_drv)
     finally:
-        static.stop()
-    log(f"serve[static]:     {static_tok_s:,.1f} useful tok/s, "
-        f"p50 {static_p50:.0f} ms, p95 {static_p95:.0f} ms")
+        static_drv.stop()
+    log(f"serve[static]:     {static['tok_s']:,.1f} useful tok/s, "
+        f"p50 {static['p50']:.0f} ms, p95 {static['p95']:.0f} ms, "
+        f"ttft p95 {static['ttft_p95']:.0f} ms, "
+        f"itl p95 {static['itl_p95']:.1f} ms")
 
     # slots A/B over the KV layout: contiguous (PR-5) vs paged pool
-    contig_tok_s, contig_p50, contig_p95, _ = replay_slots()
-    log(f"serve[contiguous]: {contig_tok_s:,.1f} useful tok/s, "
-        f"p50 {contig_p50:.0f} ms, p95 {contig_p95:.0f} ms "
-        f"({contig_tok_s / max(static_tok_s, 1e-9):.2f}x static)")
+    contig, _ = replay_slots()
+    log(f"serve[contiguous]: {contig['tok_s']:,.1f} useful tok/s, "
+        f"p50 {contig['p50']:.0f} ms, p95 {contig['p95']:.0f} ms, "
+        f"ttft p95 {contig['ttft_p95']:.0f} ms, "
+        f"itl p95 {contig['itl_p95']:.1f} ms "
+        f"({contig['tok_s'] / max(static['tok_s'], 1e-9):.2f}x static)")
 
+    # paged leg runs TWICE — tracing off then on, same engine/trace —
+    # so the per-request tracing overhead is a measured A/B, not a claim
     engine.serve.kv_layout = "paged"
+    engine.serve.request_tracing = False
+    telemetry.start()
+    untraced, _ = replay_slots()
+    engine.serve.request_tracing = True
     telemetry.start()  # clean registry: paged-leg pages/hits only
-    paged_tok_s, paged_p50, paged_p95, _ = replay_slots()
+    paged, _ = replay_slots()
+    trace_overhead = 1.0 - paged["tok_s"] / max(untraced["tok_s"], 1e-9)
     hist = telemetry.current().registry.hists.get("serve/pages_per_request")
     mean_pages = hist.total / max(hist.count, 1) if hist else 0.0
     page_size = engine.page_size_tokens()
@@ -1149,9 +1182,13 @@ def bench_serving(n_requests=96, trace_seed=17):
     paged_req_bytes = max(mean_pages, 1e-9) * page_size * kv_token_bytes
     slots_per_gb_contig = 2**30 / contig_req_bytes
     slots_per_gb_paged = 2**30 / paged_req_bytes
-    log(f"serve[paged]:      {paged_tok_s:,.1f} useful tok/s, "
-        f"p50 {paged_p50:.0f} ms, p95 {paged_p95:.0f} ms "
-        f"({paged_tok_s / max(contig_tok_s, 1e-9):.2f}x contiguous); "
+    log(f"serve[paged]:      {paged['tok_s']:,.1f} useful tok/s, "
+        f"p50 {paged['p50']:.0f} ms, p95 {paged['p95']:.0f} ms, "
+        f"ttft p95 {paged['ttft_p95']:.0f} ms, "
+        f"itl p95 {paged['itl_p95']:.1f} ms "
+        f"({paged['tok_s'] / max(contig['tok_s'], 1e-9):.2f}x contiguous, "
+        f"tracing overhead {trace_overhead:+.1%} vs "
+        f"{untraced['tok_s']:,.1f} untraced); "
         f"{mean_pages:.2f} pages/request -> {slots_per_gb_paged:,.0f} "
         f"slots/GB vs {slots_per_gb_contig:,.0f} contiguous "
         f"({slots_per_gb_paged / max(slots_per_gb_contig, 1e-9):.2f}x)")
@@ -1181,35 +1218,57 @@ def bench_serving(n_requests=96, trace_seed=17):
     prefix_sched.warmup()
     prefix_sched.start()
     try:
-        prefix_tok_s, _, prefix_p95 = replay(prefix_sched, prefix_trace)
+        prefix = replay(prefix_sched, prefix_trace)
         prefix_stats = prefix_sched.pool_stats()
     finally:
         prefix_sched.stop()
     saved = prefix_stats["prefix_tokens_saved"]
     prompt_total = sum(len(t) for t, _ in prefix_trace)
     saved_frac = saved / max(prompt_total, 1)
-    log(f"serve[prefix]:     {prefix_tok_s:,.1f} useful tok/s, "
-        f"p95 {prefix_p95:.0f} ms; {saved}/{prompt_total} prefill tokens "
-        f"skipped ({saved_frac:.0%}), hit rate "
+    log(f"serve[prefix]:     {prefix['tok_s']:,.1f} useful tok/s, "
+        f"p95 {prefix['p95']:.0f} ms, ttft p95 {prefix['ttft_p95']:.0f} "
+        f"ms, itl p95 {prefix['itl_p95']:.1f} ms; {saved}/{prompt_total} "
+        f"prefill tokens skipped ({saved_frac:.0%}), hit rate "
         f"{prefix_stats['prefix_hit_rate']:.2f}, "
         f"{prefix_stats['evicted_pages']} pages evicted")
 
     jax.block_until_ready(engine.blocks)
+
+    def slo_keys(stats, suffix=""):
+        return {
+            f"serve_ttft_p50_ms{suffix}": round(stats["ttft_p50"], 1),
+            f"serve_ttft_p95_ms{suffix}": round(stats["ttft_p95"], 1),
+            f"serve_itl_p50_ms{suffix}": round(stats["itl_p50"], 2),
+            f"serve_itl_p95_ms{suffix}": round(stats["itl_p95"], 2),
+        }
+
     return {
-        "serve_mixed_tokens_per_sec": round(paged_tok_s, 1),
-        "serve_mixed_p50_latency_ms": round(paged_p50, 1),
-        "serve_mixed_p95_latency_ms": round(paged_p95, 1),
-        "serve_mixed_tokens_per_sec_contiguous": round(contig_tok_s, 1),
-        "serve_mixed_p50_latency_ms_contiguous": round(contig_p50, 1),
-        "serve_mixed_p95_latency_ms_contiguous": round(contig_p95, 1),
-        "serve_mixed_tokens_per_sec_static": round(static_tok_s, 1),
-        "serve_mixed_p50_latency_ms_static": round(static_p50, 1),
-        "serve_mixed_p95_latency_ms_static": round(static_p95, 1),
+        "serve_mixed_tokens_per_sec": round(paged["tok_s"], 1),
+        "serve_mixed_p50_latency_ms": round(paged["p50"], 1),
+        "serve_mixed_p95_latency_ms": round(paged["p95"], 1),
+        "serve_mixed_tokens_per_sec_contiguous": round(contig["tok_s"], 1),
+        "serve_mixed_p50_latency_ms_contiguous": round(contig["p50"], 1),
+        "serve_mixed_p95_latency_ms_contiguous": round(contig["p95"], 1),
+        "serve_mixed_tokens_per_sec_static": round(static["tok_s"], 1),
+        "serve_mixed_p50_latency_ms_static": round(static["p50"], 1),
+        "serve_mixed_p95_latency_ms_static": round(static["p95"], 1),
+        # per-request SLO metrics from the lifecycle traces, per leg
+        # (paged = primary, no suffix)
+        **slo_keys(paged),
+        **slo_keys(contig, "_contiguous"),
+        **slo_keys(static, "_static"),
+        **slo_keys(prefix, "_prefix"),
+        # tracing-off A/B on the paged leg: the observed tok/s cost of
+        # per-request tracing (acceptance bar: < 5%)
+        "serve_mixed_tokens_per_sec_untraced": round(
+            untraced["tok_s"], 1
+        ),
+        "serve_trace_overhead_frac": round(trace_overhead, 4),
         "serve_mixed_vs_static": round(
-            paged_tok_s / max(static_tok_s, 1e-9), 3
+            paged["tok_s"] / max(static["tok_s"], 1e-9), 3
         ),
         "serve_paged_vs_contiguous": round(
-            paged_tok_s / max(contig_tok_s, 1e-9), 3
+            paged["tok_s"] / max(contig["tok_s"], 1e-9), 3
         ),
         "serve_kv_page_size": page_size,
         "serve_pages_per_request_mean": round(mean_pages, 2),
@@ -1223,7 +1282,7 @@ def bench_serving(n_requests=96, trace_seed=17):
         "serve_prefix_hit_rate": round(
             prefix_stats["prefix_hit_rate"], 3
         ),
-        "serve_prefix_tokens_per_sec": round(prefix_tok_s, 1),
+        "serve_prefix_tokens_per_sec": round(prefix["tok_s"], 1),
         "serve_mixed_workload": (
             f"{n_requests}-request burst, gpt2-124M geometry, prompts "
             f"2..16 tok, max_new skewed short over a 48-token gen "

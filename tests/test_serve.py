@@ -206,6 +206,31 @@ def test_deadline_flush_partial_batch(engine, fresh_registry, batcher):
     assert "serve/request_latency" in fresh_registry.hists
 
 
+def test_static_path_populates_request_trace(engine, fresh_registry,
+                                             batcher):
+    """The batch-to-completion path fills the same RequestTrace the slot
+    scheduler does: first token materializes at decode END (the whole
+    decode is one program) and ITL is the uniform decode_time/tokens
+    approximation (trlx_tpu/serve/trace.py note_static_decode)."""
+    req = batcher.submit([1, 2, 3], max_new_tokens=4)
+    req.wait(timeout=30.0)
+    tr = req.trace
+    assert tr is not None
+    assert tr.received <= tr.enqueued <= tr.admitted
+    assert tr.admitted <= tr.prefill_end <= tr.first_token
+    assert tr.first_token == tr.last_token  # batch-to-completion
+    assert tr.harvested >= tr.first_token
+    assert tr.bucket is not None
+    assert tr.ttft() > 0.0
+    if len(req.result) > 1:
+        assert tr.itl_count == len(req.result) - 1
+        assert tr.itl_min == tr.itl_max  # uniform approximation
+    # complete("static", ...) derived the SLO family + per-path latency
+    assert fresh_registry.hists["serve/ttft"].count == 1
+    assert fresh_registry.hists["serve/request_latency_static"].count == 1
+    assert "serve/goodput" in fresh_registry.gauges
+
+
 def test_full_bucket_flushes_before_deadline(engine, fresh_registry):
     b = MicroBatcher(engine, max_wait_ms=30_000.0).start()
     try:

@@ -141,6 +141,32 @@ def test_lint(path):
                         f"control-flow deadlines) so the measurement "
                         f"reaches the metrics stream"
                     )
+        if (lib / "serve") in path.parents:
+            # the serve path is stricter still: request traces do
+            # arithmetic across timestamps stamped by different threads
+            # (HTTP edge, scheduler worker), which is only sound if every
+            # one comes from the SAME clock — supervisor.monotonic. Ban
+            # the `time`/`datetime` modules outright so a mixed-clock
+            # TTFT can't be introduced by an innocent-looking import.
+            for node in ast.walk(tree):
+                banned = None
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name.split(".")[0] in ("time", "datetime"):
+                            banned = alias.name
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or "").split(".")[0] in (
+                        "time", "datetime"
+                    ):
+                        banned = node.module
+                if banned:
+                    problems.append(
+                        f"line {node.lineno}: serve-path import of "
+                        f"'{banned}' — serve code records wall-clock "
+                        f"times only via trlx_tpu.supervisor.monotonic "
+                        f"(one clock source keeps trace arithmetic "
+                        f"sound; see trlx_tpu/serve/trace.py)"
+                    )
         for node in ast.walk(tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue

@@ -338,6 +338,11 @@ class TrainConfig:
     # then only written when that directory exists (a checkpoint has been
     # committed); an explicit path is always created and written.
     telemetry_dir: str = ""
+    # flush the telemetry summary/trace to run_dir every N training
+    # iterations (reusing the learn()-exit writer), so a SIGKILL'd run —
+    # which never reaches the exit hook — still leaves observability
+    # artifacts no older than N iterations. 0 (default) = exit-only.
+    telemetry_flush_every: int = 0
     debug_nans: bool = False
 
     @classmethod

@@ -75,6 +75,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pages", type=int, default=None,
                    help="page-pool size under --kv-layout paged "
                         "(0 = slots x pages-per-slot capacity parity)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT objective for serve/goodput (fraction of "
+                        "requests whose first token beat it; 0 = all "
+                        "count good)")
+    p.add_argument("--flight-recorder-steps", type=int, default=None,
+                   help="engine-step black-box ring size dumped on "
+                        "stalls and served at /debug/state (0 = off)")
+    p.add_argument("--no-request-tracing", action="store_true",
+                   help="disable per-request lifecycle tracing (the "
+                        "serve/ttft|itl|goodput SLO family and the "
+                        "'trace': true response payload)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip lattice precompilation at startup (first "
                         "request per bucket then pays the compile)")
@@ -100,10 +111,14 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("slots", "slots"),
                        ("kv_layout", "kv_layout"),
                        ("page_size", "page_size"),
-                       ("pages", "pages")):
+                       ("pages", "pages"),
+                       ("slo_ttft_ms", "slo_ttft_ms"),
+                       ("flight_recorder_steps", "flight_recorder_steps")):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, attr, value)
+    if args.no_request_tracing:
+        cfg.request_tracing = False
     return cfg
 
 

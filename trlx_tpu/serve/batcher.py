@@ -47,6 +47,7 @@ from collections import deque
 from typing import List, Optional
 
 from trlx_tpu import supervisor, telemetry
+from trlx_tpu.serve.trace import RequestTrace
 from trlx_tpu.supervisor import chaos, monotonic
 
 
@@ -59,10 +60,12 @@ class Request:
     """One queued generation request and its completion slot."""
 
     __slots__ = ("tokens", "max_new_tokens", "seed", "shape",
-                 "enqueued_at", "done", "result", "error", "latency_s")
+                 "enqueued_at", "done", "result", "error", "latency_s",
+                 "trace")
 
     def __init__(self, tokens: List[int], max_new_tokens: int,
-                 shape, seed: Optional[int] = None):
+                 shape, seed: Optional[int] = None,
+                 trace: Optional[RequestTrace] = None):
         self.tokens = tokens
         self.max_new_tokens = max_new_tokens
         self.seed = seed
@@ -72,6 +75,9 @@ class Request:
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
         self.latency_s: float = 0.0
+        self.trace = trace
+        if trace is not None:
+            trace.enqueued = self.enqueued_at
 
     def wait(self, timeout: Optional[float] = None) -> "Request":
         """Block until decoded; re-raises the worker-side error if the
@@ -99,6 +105,8 @@ class MicroBatcher:
             cfg.max_wait_ms if max_wait_ms is None else max_wait_ms
         ) / 1000.0
         self.max_queue = cfg.max_queue if max_queue is None else max_queue
+        self._tracing = bool(getattr(cfg, "request_tracing", True))
+        self._slo_s = float(getattr(cfg, "slo_ttft_ms", 0.0)) / 1000.0
         #: optional trlx_tpu.supervisor.RunSupervisor — ENTERED BY THE
         #: WORKER THREAD so its phase stack describes the decode loop
         self.run_supervisor = run_supervisor
@@ -141,9 +149,12 @@ class MicroBatcher:
         return len(self._queue)
 
     def submit(self, tokens: List[int], max_new_tokens: Optional[int] = None,
-               seed: Optional[int] = None) -> Request:
+               seed: Optional[int] = None,
+               trace: Optional[RequestTrace] = None) -> Request:
         """Enqueue one request (bucket-rounded); raises ValueError when
-        no lattice bucket fits, QueueFull past ``max_queue``."""
+        no lattice bucket fits, QueueFull past ``max_queue``. An explicit
+        ``trace`` (the HTTP layer's, carrying ``received``) is attached
+        as-is; otherwise one is minted here when tracing is on."""
         if not tokens:
             raise ValueError("empty prompt: at least one token is required")
         if max_new_tokens is None:
@@ -152,7 +163,10 @@ class MicroBatcher:
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
-        req = Request(list(tokens), max_new_tokens, shape, seed=seed)
+        if trace is None and self._tracing:
+            trace = RequestTrace()
+        req = Request(list(tokens), max_new_tokens, shape, seed=seed,
+                      trace=trace)
         with self._cond:
             if len(self._queue) >= self.max_queue:
                 telemetry.inc("serve/rejected")
@@ -215,6 +229,11 @@ class MicroBatcher:
         tokens, mask = self.engine.pad_batch(
             [r.tokens for r in batch], bucket
         )
+        admit_at = monotonic()
+        for r in batch:
+            if r.trace is not None:
+                r.trace.admitted = admit_at
+                r.trace.bucket = (B, shape[0])
         with supervisor.phase("serve_decode"):
             chaos.maybe_inject("serve_decode")
             out = self.engine.decode(bucket, tokens, mask, seed=seed)
@@ -227,7 +246,15 @@ class MicroBatcher:
             req.result = self.engine.depad_row(out, i, req.max_new_tokens)
             gen_total += len(req.result)
             req.latency_s = done_at - req.enqueued_at
+            # kept for dashboard continuity; superseded by the per-path
+            # serve/request_latency_static histogram complete() observes
             telemetry.observe("serve/request_latency", req.latency_s)
+            if req.trace is not None:
+                req.trace.note_static_decode(
+                    admit_at, done_at, len(req.result)
+                )
+                req.trace.harvested = done_at
+                req.trace.complete("static", self._slo_s)
             req.done.set()
         telemetry.inc("serve/responses", len(batch))
         telemetry.inc("serve/batches")

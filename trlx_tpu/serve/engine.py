@@ -102,6 +102,22 @@ class ServeConfig:
         page_size)`` pages, so mixed-length traffic packs more live
         slots into the same HBM (docs/source/serving.rst has the
         pages-per-GB formula).
+    :param request_tracing: per-request lifecycle tracing
+        (trlx_tpu.serve.trace): every request carries a
+        :class:`RequestTrace` with monotonic timestamps at each edge
+        (received/enqueued/admitted/prefill/first-token/harvested),
+        feeding the ``serve/ttft`` / ``serve/itl`` / ``serve/goodput``
+        SLO family, Perfetto per-request tracks, and the opt-in
+        ``"trace": true`` response payload. Host-side only; disable for
+        the A/B baseline (bench_serving measures the overhead).
+    :param slo_ttft_ms: the TTFT service-level objective in ms —
+        ``serve/goodput`` is the fraction of completed requests whose
+        time-to-first-token beat it. 0 counts every request as good.
+    :param flight_recorder_steps: ring size of the slot scheduler's
+        per-step flight recorder (step index, lane counts, occupancy,
+        pages_free, admissions/evictions, step walltime); dumped on
+        watchdog stalls, chaos firings, and poisoned-step resets, and
+        served live at ``GET /debug/state``. 0 disables.
     """
 
     buckets: List[List[int]] = field(
@@ -119,6 +135,9 @@ class ServeConfig:
     kv_layout: str = "paged"
     page_size: int = 64
     pages: int = 0
+    request_tracing: bool = True
+    slo_ttft_ms: float = 500.0
+    flight_recorder_steps: int = 256
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -202,6 +221,17 @@ class InferenceEngine:
         if self.serve.pages < 0:
             raise ValueError(
                 f"serve.pages={self.serve.pages} must be >= 0 (0 = auto)"
+            )
+        if self.serve.slo_ttft_ms < 0:
+            raise ValueError(
+                f"serve.slo_ttft_ms={self.serve.slo_ttft_ms} must be >= 0 "
+                f"(0 = every completed request counts toward goodput)"
+            )
+        if self.serve.flight_recorder_steps < 0:
+            raise ValueError(
+                f"serve.flight_recorder_steps="
+                f"{self.serve.flight_recorder_steps} must be >= 0 "
+                f"(0 = disabled)"
             )
         self.buckets = _normalize_buckets(self.serve.buckets)
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)

@@ -1,22 +1,36 @@
 """Stdlib HTTP endpoint over the inference engine + micro-batcher.
 
 ``ThreadingHTTPServer`` + JSON — no new dependencies, matching the rest
-of the codebase's stdlib-only host layer. Three routes:
+of the codebase's stdlib-only host layer. Four routes:
 
 - ``POST /generate`` — body ``{"prompt": str | "tokens": [int],
-  "max_new_tokens": int?, "seed": int?}``; returns the completion with
-  its de-padded tokens, the bucket shape class that served it, and the
-  measured queue+decode latency. Errors are typed: 400 (bad request / no
-  bucket fits), 429 (queue full — admission control), 503 (request
-  timed out past ``serve.request_timeout``), 500 (decode/chaos failure).
+  "max_new_tokens": int?, "seed": int?, "trace": bool?}``; returns the
+  completion with its de-padded tokens, the bucket shape class that
+  served it, the measured queue+decode latency, and (tracing on) its
+  ``trace_id`` — minted at THIS edge, or honored from an inbound
+  ``X-Request-Id`` header, and echoed back as ``X-Request-Id`` so
+  client/server logs join on it. ``"trace": true`` additionally returns
+  the request's full lifecycle breakdown
+  (trlx_tpu.serve.trace.RequestTrace.to_dict). Errors are typed: 400
+  (bad request / no bucket fits), 429 (queue full — admission control),
+  503 (request timed out past ``serve.request_timeout``), 500
+  (decode/chaos failure).
 - ``GET /healthz`` — liveness + lattice + queue depth. A process whose
   decode thread is wedged still answers (HTTP is a different thread) —
   which is exactly why the batcher runs under the supervisor watchdog:
   the hang surfaces as a stack-dumping stall (``fault/stalls``) rather
   than a green health check over a dead port.
-- ``GET /metrics`` — the full telemetry registry summary (counters,
-  gauges, timing histograms with p50/p95 and first-call-apart compile
-  latencies), the same shape ``telemetry.json`` persists.
+- ``GET /metrics`` — content-negotiated: the default is the full
+  telemetry registry summary as JSON (counters, gauges, timing
+  histograms with p50/p95 and first-call-apart compile latencies — the
+  shape ``telemetry.json`` persists); an ``Accept`` header naming
+  ``text/plain``, ``openmetrics`` or ``prometheus`` gets the Prometheus
+  text exposition instead (trlx_tpu.telemetry.prometheus), so a
+  Prometheus server scrapes the endpoint directly.
+- ``GET /debug/state`` — the live engine state: queue depth, per-slot
+  occupancy map (trace ids, emitted-token counts, page counts), the
+  flight-recorder ring, and the KV pool/radix stats. The slot
+  scheduler's black box, readable BEFORE a stall forces a dump.
 
 Request handling runs through :func:`trlx_tpu.supervisor.bounded_call`
 (``serve.request_timeout``): a request wedged behind a hung decode
@@ -34,7 +48,14 @@ from typing import Optional
 
 from trlx_tpu import telemetry
 from trlx_tpu.serve.batcher import MicroBatcher, QueueFull
-from trlx_tpu.supervisor import RunSupervisor, SeamTimeout, bounded_call, chaos
+from trlx_tpu.serve.trace import SLO_COUNTERS, RequestTrace
+from trlx_tpu.supervisor import (
+    RunSupervisor,
+    SeamTimeout,
+    bounded_call,
+    chaos,
+    monotonic,
+)
 
 #: counters pre-registered when a server starts so the ``serve/*`` series
 #: exist in /metrics from the first scrape, not the first event
@@ -68,13 +89,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- helpers --------------------------------------------------------- #
 
-    def _json(self, code: int, payload: dict) -> None:
+    def _json(self, code: int, payload: dict, headers=None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
 
     def _error(self, code: int, message: str) -> None:
         self._json(code, {"error": message})
@@ -100,16 +131,43 @@ class _Handler(BaseHTTPRequestHandler):
                 body["kv"] = pool_stats()
             self._json(200, body)
         elif self.path == "/metrics":
-            self._json(200, telemetry.summary())
+            accept = self.headers.get("Accept", "") or ""
+            wants_text = any(
+                key in accept.lower()
+                for key in ("text/plain", "openmetrics", "prometheus")
+            )
+            if wants_text:
+                from trlx_tpu.telemetry import prometheus
+
+                self._text(
+                    200, telemetry.prometheus_text(), prometheus.CONTENT_TYPE
+                )
+            else:
+                self._json(200, telemetry.summary())
+        elif self.path == "/debug/state":
+            state_fn = getattr(srv.batcher, "debug_state", None)
+            if state_fn is not None:
+                self._json(200, state_fn())
+            else:  # static micro-batcher: no slot map / flight recorder
+                self._json(200, {
+                    "scheduler": srv.engine.serve.scheduler,
+                    "queue_depth": srv.batcher.queue_depth(),
+                    "slots": {},
+                    "flight_recorder": [],
+                })
         else:
             self._error(404, f"no route '{self.path}' (have /generate "
-                             f"[POST], /healthz, /metrics)")
+                             f"[POST], /healthz, /metrics, /debug/state)")
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
         if self.path != "/generate":
             self._error(404, f"no POST route '{self.path}'")
             return
         srv = self.server_ref
+        # the trace clock starts at the HTTP edge, before body parsing;
+        # an inbound X-Request-Id becomes the trace id (client log join)
+        received_at = monotonic()
+        request_id = self.headers.get("X-Request-Id") or None
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -120,7 +178,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             payload = bounded_call(
-                lambda: srv.handle_generate(body),
+                lambda: srv.handle_generate(
+                    body, trace_id=request_id, received_at=received_at
+                ),
                 timeout=srv.engine.serve.request_timeout,
                 label="serve_request",
             )
@@ -137,7 +197,10 @@ class _Handler(BaseHTTPRequestHandler):
             telemetry.inc("serve/request_errors")
             self._error(500, f"{type(e).__name__}: {e}")
             return
-        self._json(200, payload)
+        headers = {}
+        if payload.get("trace_id"):
+            headers["X-Request-Id"] = payload["trace_id"]
+        self._json(200, payload, headers=headers)
 
 
 class InferenceServer:
@@ -178,6 +241,11 @@ class InferenceServer:
             self.batcher = SlotScheduler(engine, run_supervisor=sup)
         else:
             self.batcher = MicroBatcher(engine, run_supervisor=sup)
+        dump_fn = getattr(self.batcher, "dump_flight_recorder", None)
+        if sup is not None and dump_fn is not None:
+            # a watchdog stall dumps the engine-step ring next to the
+            # all-thread stack dump (trlx_tpu.serve.trace.FlightRecorder)
+            sup.add_dump_fn(dump_fn)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
 
@@ -191,10 +259,13 @@ class InferenceServer:
 
     # -- request semantics ---------------------------------------------- #
 
-    def handle_generate(self, body: dict) -> dict:
+    def handle_generate(self, body: dict, trace_id: Optional[str] = None,
+                        received_at: Optional[float] = None) -> dict:
         """One request end-to-end: tokenize, submit, wait, shape the
         response. Runs inside bounded_call — raising is the error path
-        (the handler maps exception types to HTTP codes)."""
+        (the handler maps exception types to HTTP codes). ``trace_id``
+        and ``received_at`` come from the HTTP edge; direct callers may
+        omit both (the scheduler mints a trace at submit)."""
         chaos.maybe_inject("serve_request")
         if "tokens" in body:
             tokens = [int(t) for t in body["tokens"]]
@@ -205,12 +276,16 @@ class InferenceServer:
                              "(token-id list)")
         max_new = body.get("max_new_tokens")
         seed = body.get("seed")
+        trace = None
+        if self.engine.serve.request_tracing:
+            trace = RequestTrace(trace_id=trace_id, received=received_at)
         req = self.batcher.submit(
             tokens, max_new_tokens=max_new,
             seed=None if seed is None else int(seed),
+            trace=trace,
         )
         req.wait()  # bounded by the caller's bounded_call
-        return {
+        payload = {
             "tokens": req.result,
             "text": self.engine.tokenizer.decode(
                 req.result, skip_special_tokens=True
@@ -219,11 +294,20 @@ class InferenceServer:
             "latency_ms": round(req.latency_s * 1000.0, 3),
             "queue_depth": self.batcher.queue_depth(),
         }
+        if req.trace is not None:
+            req.trace.responded = monotonic()
+            payload["trace_id"] = req.trace.trace_id
+            if body.get("trace"):
+                payload["trace"] = req.trace.to_dict()
+        return payload
 
     # -- lifecycle ------------------------------------------------------- #
 
     def start(self, warmup: bool = True) -> "InferenceServer":
         telemetry.predeclare(_SERVE_COUNTERS)
+        if self.engine.serve.request_tracing:
+            telemetry.predeclare(SLO_COUNTERS)
+            telemetry.set_gauge("serve/goodput", 0.0)
         if self.engine.serve.scheduler == "slots":
             telemetry.set_gauge("serve/slot_occupancy", 0.0)
             cache = getattr(self.batcher, "cache", None)

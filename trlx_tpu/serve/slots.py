@@ -65,6 +65,7 @@ import numpy as np
 
 from trlx_tpu import supervisor, telemetry
 from trlx_tpu.serve.batcher import QueueFull, Request
+from trlx_tpu.serve.trace import FlightRecorder, RequestTrace
 from trlx_tpu.supervisor import chaos, monotonic
 
 #: filler rows in a prefill bucket aim at slot id == num_slots — one past
@@ -371,6 +372,18 @@ class SlotScheduler:
         #: (event, slot, request) ring — "admit"/"free"; the e2e tests
         #: read it to prove a freed slot was reused mid-decode
         self.events = deque(maxlen=4096)
+        self._tracing = bool(getattr(cfg, "request_tracing", True))
+        self._slo_s = float(getattr(cfg, "slo_ttft_ms", 0.0)) / 1000.0
+        #: per-step engine black box (serve.flight_recorder_steps; 0
+        #: disables); dumped on stall/chaos/poison, served at /debug/state
+        fr_steps = int(getattr(cfg, "flight_recorder_steps", 0))
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(fr_steps) if fr_steps > 0 else None
+        )
+        # admissions/evictions since the last flight-recorder record —
+        # reset by _run after each step's record lands in the ring
+        self._fr_admitted = 0
+        self._fr_evicted = 0
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -418,7 +431,8 @@ class SlotScheduler:
 
     def submit(self, tokens: List[int],
                max_new_tokens: Optional[int] = None,
-               seed: Optional[int] = None) -> Request:
+               seed: Optional[int] = None,
+               trace: Optional[RequestTrace] = None) -> Request:
         """Enqueue one request; same validation/admission contract as the
         static micro-batcher (ValueError when no bucket fits, QueueFull
         past ``max_queue``). ``seed`` is accepted for surface parity but
@@ -444,7 +458,10 @@ class SlotScheduler:
                     f"serve.pages (or serve.page_size) — queueing could "
                     f"never admit it"
                 )
-        req = Request(list(tokens), max_new_tokens, shape, seed=seed)
+        if trace is None and self._tracing:
+            trace = RequestTrace()
+        req = Request(list(tokens), max_new_tokens, shape, seed=seed,
+                      trace=trace)
         with self._cond:
             if len(self._queue) >= self.max_queue:
                 telemetry.inc("serve/rejected")
@@ -497,6 +514,8 @@ class SlotScheduler:
                     # pool lanes were only touched if the device call
                     # ran, and dropped-sentinel scatters cannot corrupt
                     # live slots
+                    if self.flight is not None:
+                        self.flight.dump(f"admission failure: {e!r}")
                     telemetry.inc("serve/request_errors", len(batch))
                     for r in batch:
                         r.error = e
@@ -522,14 +541,24 @@ class SlotScheduler:
         tokens, mask = self.engine.pad_batch(rows, (Bp, P, 0))
         max_new = [r.max_new_tokens for r in batch]
         max_new += [1] * (Bp - len(batch))
+        admit_at = monotonic()
+        for r in batch:
+            if r.trace is not None:
+                r.trace.admitted = admit_at
+                r.trace.bucket = (Bp, P)
+                r.trace.prefill_start = admit_at
         try:
             self.runtime.prefill((Bp, P), tokens, mask, slot_ids, max_new)
         except Exception:
             self._free.extend(slots)  # nothing was admitted
             raise
+        prefill_end = monotonic()
         for r, s in zip(batch, slots):
+            if r.trace is not None:
+                r.trace.prefill_end = prefill_end
             self._live[s] = _LiveSlot(r)
             self.events.append(("admit", s, r))
+        self._fr_admitted += len(batch)
         telemetry.inc("serve/admissions", len(batch))
         telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
         return True
@@ -562,6 +591,8 @@ class SlotScheduler:
         if deferred:
             with self._cond:
                 for r in reversed(deferred):
+                    if r.trace is not None:  # page starvation -> re-queued
+                        r.trace.queue_reentries += 1
                     self._queue.appendleft(r)
                 telemetry.set_gauge("serve/queue_depth", len(self._queue))
             # the _admit exception handler must not fail re-queued rows
@@ -583,6 +614,7 @@ class SlotScheduler:
         starts = np.zeros((Bp,), np.int32)
         max_new = np.ones((Bp,), np.int32)
         slot_ids = np.full((Bp,), self.runtime.num_slots, np.int32)
+        admit_at = monotonic()
         for j, ((r, toks, matched, pages, _), s) in enumerate(
             zip(plans, slots)
         ):
@@ -594,6 +626,13 @@ class SlotScheduler:
             starts[j] = start
             max_new[j] = r.max_new_tokens
             slot_ids[j] = s
+            if r.trace is not None:
+                r.trace.admitted = admit_at
+                r.trace.bucket = (Bp, P)
+                r.trace.prefill_start = admit_at
+                r.trace.pages_reserved = len(pages)
+                r.trace.prefix_blocks_hit = len(matched)
+                r.trace.suffix_len = len(suf)
         try:
             self.runtime.prefill(
                 (Bp, P), tokens, mask, slot_ids, max_new,
@@ -607,13 +646,17 @@ class SlotScheduler:
             for _, _, _, pages, _ in plans:
                 self.cache.release_all(pages)
             raise
+        prefill_end = monotonic()
         saved = 0
         for (r, toks, matched, pages, committed), s in zip(plans, slots):
+            if r.trace is not None:
+                r.trace.prefill_end = prefill_end
             self._live[s] = _LiveSlot(r, pages=pages, committed=committed)
             self.events.append(("admit", s, r))
             saved += len(matched) * ps
             self._prompt_tokens_total += len(toks)
             telemetry.observe("serve/pages_per_request", len(pages))
+        self._fr_admitted += len(plans)
         self._prefix_tokens_saved += saved
         if saved:
             telemetry.inc("serve/prefix_tokens_saved", saved)
@@ -670,11 +713,18 @@ class SlotScheduler:
             if emitted[slot]:
                 live.tokens.append(int(tok[slot]))
                 emitted_total += 1
+                if live.request.trace is not None:
+                    live.request.trace.note_token(done_at)
             if finished[slot]:
                 req = live.request
                 req.result = live.tokens
                 req.latency_s = done_at - req.enqueued_at
+                # kept for dashboard continuity; superseded by the
+                # per-path serve/request_latency_slots histogram
                 telemetry.observe("serve/request_latency", req.latency_s)
+                if req.trace is not None:
+                    req.trace.harvested = done_at
+                    req.trace.complete("slots", self._slo_s)
                 req.done.set()
                 del self._live[slot]
                 self._free.append(slot)
@@ -687,6 +737,7 @@ class SlotScheduler:
                         "serve/pages_free", self.cache.free_pages()
                     )
                 self.events.append(("free", slot, req))
+                self._fr_evicted += 1
                 telemetry.inc("serve/evictions")
                 telemetry.inc("serve/responses")
         if emitted_total:
@@ -702,7 +753,11 @@ class SlotScheduler:
 
     def _fail_live(self, error: BaseException) -> None:
         """Poisoned-step containment: fail every in-flight request, free
-        all slots, reset the device lanes, keep the loop serving."""
+        all slots, reset the device lanes, keep the loop serving. The
+        flight recorder dumps FIRST — the engine state that led into the
+        poisoned step is exactly what the ring holds."""
+        if self.flight is not None:
+            self.flight.dump(f"poisoned step: {error!r}")
         live = list(self._live.values())
         self._live.clear()
         self._free = list(range(self.runtime.num_slots))
@@ -727,6 +782,65 @@ class SlotScheduler:
             s.request.done.set()
         telemetry.set_gauge("serve/slot_occupancy", 0.0)
 
+    def _record_step(self, start: float, end: float) -> None:
+        """One compact flight-recorder record per engine step; the
+        admitted/evicted deltas accumulated since the last record reset
+        here so each record owns exactly its step's churn."""
+        if self.flight is None:
+            self._fr_admitted = self._fr_evicted = 0
+            return
+        rec = {
+            "step": self._step_counter,
+            "t": round(end, 4),
+            "active": len(self._live),
+            "finished": self._fr_evicted,
+            "admitted": self._fr_admitted,
+            "occupancy": round(self._occupancy(), 4),
+            "step_ms": round((end - start) * 1000.0, 3),
+        }
+        if self.cache is not None:
+            rec["pages_free"] = self.cache.free_pages()
+        self.flight.record(**rec)
+        self._fr_admitted = self._fr_evicted = 0
+
+    def dump_flight_recorder(self) -> None:
+        """Supervisor stall hook (``RunSupervisor.add_dump_fn``): print
+        the ring to stderr next to the watchdog's all-thread stack dump
+        so a stall is attributable to a concrete engine state."""
+        if self.flight is not None:
+            self.flight.dump("watchdog stall")
+
+    def debug_state(self) -> Dict:
+        """Live engine state for ``GET /debug/state``: queue/slot map,
+        the flight-recorder ring, and the KV pool/radix stats. Read from
+        the HTTP thread without a lock — every container is copied (or
+        read atomically) under the GIL, so a torn view is impossible and
+        a slightly stale one is fine for a debug endpoint."""
+        slots = {}
+        for s, live in list(self._live.items()):
+            req = live.request
+            slots[str(s)] = {
+                "trace_id": req.trace.trace_id
+                if req.trace is not None else None,
+                "prompt_len": len(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "tokens_emitted": len(live.tokens),
+                "pages": len(live.pages),
+            }
+        return {
+            "scheduler": "slots",
+            "step": self._step_counter,
+            "queue_depth": len(self._queue),
+            "free_slots": len(self._free),
+            "starved": self._starved,
+            "slots": slots,
+            "flight_recorder": (
+                self.flight.snapshot() if self.flight is not None else []
+            ),
+            "flight_dumps": self.flight.dumps if self.flight else 0,
+            "kv": self.pool_stats(),
+        }
+
     def _run(self) -> None:
         sup_cm = self.run_supervisor
         if sup_cm is None:
@@ -741,7 +855,10 @@ class SlotScheduler:
                         if not self._queue and not self._stop.is_set():
                             self._cond.wait(timeout=0.1)
                     continue
+                step_start = monotonic()
                 try:
                     self._step()
                 except Exception as e:
                     self._fail_live(e)
+                else:
+                    self._record_step(step_start, monotonic())

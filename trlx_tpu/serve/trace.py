@@ -1,0 +1,277 @@
+"""Request-lifecycle tracing + the engine-step flight recorder.
+
+The serve path (HTTP -> queue -> admission -> prefill -> step-level
+decode -> harvest) reported one end-to-end ``serve/request_latency``
+histogram — a p95 regression was unattributable to queueing vs prefill
+vs decode contention, and none of the SLO metrics the continuous-
+batching literature optimizes (TTFT, ITL) existed at all. This module
+is the host-side-only fix; nothing here crosses into a jitted program:
+
+- :class:`RequestTrace` — one per request (``serve.request_tracing``,
+  default on). A trace ID is minted at the HTTP edge (an inbound
+  ``X-Request-Id`` is honored) and the record accumulates monotonic
+  timestamps at every lifecycle edge: received, enqueued, admitted
+  (with pages reserved, prefix blocks hit, and queue re-entries on page
+  starvation), prefill start/end (bucket + suffix length), first token,
+  per-step token times aggregated to ITL count/total/min/max (never
+  stored raw), harvested, responded. :meth:`complete` derives the SLO
+  family — ``serve/ttft``, ``serve/itl``, ``serve/queue_time``,
+  ``serve/prefill_time``, ``serve/decode_time``, per-scheduler
+  ``serve/request_latency_<path>`` histograms and the ``serve/goodput``
+  gauge (fraction of requests with TTFT under ``serve.slo_ttft_ms``) —
+  and exports the request as its own Perfetto track (one ``tid`` per
+  request, child spans per phase) through the session's SpanTracer.
+- :class:`FlightRecorder` — a fixed-size ring
+  (``serve.flight_recorder_steps``) the slot scheduler appends one
+  compact record to per engine step: step index, active/finished lane
+  counts, occupancy, pages_free, admissions/evictions this step, step
+  wall time. On a watchdog stall, a chaos-seam firing, or a
+  poisoned-step reset the last N records dump next to the stack dump,
+  so "stalled" is attributable to a concrete engine state (e.g.
+  ``pages_free`` pinned at 0); ``GET /debug/state`` serves the live
+  ring.
+
+Every timestamp is ``trlx_tpu.supervisor.monotonic`` — serve-path code
+may not touch any other wall clock (tests/test_style.py enforces it),
+so trace arithmetic can never mix clock sources.
+"""
+
+import itertools
+import json
+import sys
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from trlx_tpu import telemetry
+from trlx_tpu.supervisor import monotonic
+
+#: the SLO histogram family complete() observes (docs "Observability");
+#: the server predeclares the counters so scrapes see zeros, not gaps
+SLO_COUNTERS = ("serve/slo_good", "serve/slo_total", "serve/flight_dumps")
+
+#: Perfetto track ids: one per request, starting clear of tid 0 (the
+#: process-level span track the tracer already uses)
+_TID = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RequestTrace:
+    """Monotonic lifecycle timestamps + ITL aggregate for one request.
+
+    All fields are plain floats/ints written by whichever thread owns
+    that lifecycle edge (HTTP handler, scheduler worker) — never two at
+    once, so no locking. Unset edges stay 0.0.
+    """
+
+    __slots__ = (
+        "trace_id", "tid", "received", "enqueued", "admitted",
+        "prefill_start", "prefill_end", "first_token", "last_token",
+        "harvested", "responded", "queue_reentries", "pages_reserved",
+        "prefix_blocks_hit", "bucket", "suffix_len",
+        "itl_count", "itl_total", "itl_min", "itl_max",
+    )
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 received: Optional[float] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.tid = next(_TID)
+        self.received = monotonic() if received is None else received
+        self.enqueued = 0.0
+        self.admitted = 0.0
+        self.prefill_start = 0.0
+        self.prefill_end = 0.0
+        self.first_token = 0.0
+        self.last_token = 0.0
+        self.harvested = 0.0
+        self.responded = 0.0
+        self.queue_reentries = 0
+        self.pages_reserved = 0
+        self.prefix_blocks_hit = 0
+        self.bucket = None  # (batch_extent, prompt_len) admission bucket
+        self.suffix_len = 0
+        self.itl_count = 0
+        self.itl_total = 0.0
+        self.itl_min = 0.0
+        self.itl_max = 0.0
+
+    # -- lifecycle edges -------------------------------------------------- #
+
+    def note_token(self, now: float) -> None:
+        """One emitted token at ``now`` (the step's harvest timestamp).
+        The first sets TTFT's numerator; later ones fold their gap into
+        the ITL aggregate AND the global ``serve/itl`` histogram (the
+        per-gap distribution — raw timestamps are never stored)."""
+        if not self.first_token:
+            self.first_token = now
+        else:
+            gap = now - self.last_token
+            if not self.itl_count or gap < self.itl_min:
+                self.itl_min = gap
+            if gap > self.itl_max:
+                self.itl_max = gap
+            self.itl_count += 1
+            self.itl_total += gap
+            telemetry.observe("serve/itl", gap)
+        self.last_token = now
+
+    def note_static_decode(self, start: float, end: float,
+                           n_tokens: int) -> None:
+        """The batch-to-completion path has no per-step timestamps — the
+        whole decode is one program, so its first token materializes at
+        decode END and ITL is the uniform ``decode_time / tokens``
+        approximation (one ``serve/itl`` observation per request, not
+        per gap — documented in docs/source/observability.rst)."""
+        self.prefill_start = self.prefill_end = start
+        self.first_token = self.last_token = end
+        if n_tokens > 1:
+            gap = (end - start) / n_tokens
+            self.itl_count = n_tokens - 1
+            self.itl_total = gap * self.itl_count
+            self.itl_min = self.itl_max = gap
+            telemetry.observe("serve/itl", gap)
+
+    def itl_mean(self) -> float:
+        return self.itl_total / self.itl_count if self.itl_count else 0.0
+
+    def ttft(self) -> float:
+        base = self.received or self.enqueued
+        return max(self.first_token - base, 0.0) if self.first_token \
+            else 0.0
+
+    # -- completion -------------------------------------------------------- #
+
+    def complete(self, path: str, slo_ttft_s: float) -> None:
+        """Harvest-time derivation: observe the SLO histogram family,
+        update goodput, and export this request as a Perfetto track.
+        Called once by the scheduler that finished the request (works
+        for direct ``submit()`` callers too — bench/tests never touch
+        HTTP); ``responded`` is stamped later by the HTTP layer and
+        appears in the JSON trace, not in the exported spans."""
+        telemetry.observe("serve/ttft", self.ttft())
+        if self.admitted:
+            telemetry.observe(
+                "serve/queue_time", max(self.admitted - self.enqueued, 0.0)
+            )
+        if self.prefill_end:
+            telemetry.observe(
+                "serve/prefill_time", self.prefill_end - self.prefill_start
+            )
+            telemetry.observe(
+                "serve/decode_time", max(self.harvested - self.prefill_end,
+                                         0.0)
+            )
+        telemetry.observe(
+            f"serve/request_latency_{path}", self.harvested - self.enqueued
+        )
+        telemetry.inc("serve/slo_total")
+        tel = telemetry.current()
+        if tel is None:
+            return
+        good = tel.registry.inc("serve/slo_good", 0.0)
+        if slo_ttft_s <= 0 or self.ttft() <= slo_ttft_s:
+            good = tel.registry.inc("serve/slo_good")
+        total = tel.registry.counters.get("serve/slo_total", 1.0)
+        tel.registry.set_gauge("serve/goodput", good / max(total, 1.0))
+        self._export_spans(tel.tracer)
+
+    def _export_spans(self, tracer) -> None:
+        """One Perfetto track per request (this trace's ``tid``): a
+        parent ``serve/request`` span over the whole lifecycle with
+        queue/prefill/decode child spans nested inside it."""
+        end = self.harvested or self.last_token or self.admitted \
+            or self.enqueued
+        start = self.received or self.enqueued
+        if end <= 0 or start <= 0:
+            return
+        tracer.name_track(self.tid, f"req {self.trace_id}")
+        args: Dict[str, Any] = {"trace_id": self.trace_id}
+        if self.bucket is not None:
+            args["bucket"] = list(self.bucket)
+        if self.pages_reserved:
+            args["pages_reserved"] = self.pages_reserved
+        if self.prefix_blocks_hit:
+            args["prefix_blocks_hit"] = self.prefix_blocks_hit
+        if self.queue_reentries:
+            args["queue_reentries"] = self.queue_reentries
+        tracer.add_span("serve/request", start, end, tid=self.tid,
+                        args=args)
+        if self.admitted:
+            tracer.add_span("serve/req_queue", self.enqueued, self.admitted,
+                            tid=self.tid)
+        if self.prefill_end:
+            tracer.add_span("serve/req_prefill", self.prefill_start,
+                            self.prefill_end, tid=self.tid)
+            tracer.add_span("serve/req_decode", self.prefill_end, end,
+                            tid=self.tid)
+
+    # -- export ------------------------------------------------------------ #
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The opt-in ``"trace": true`` response payload — millisecond
+        durations (the JSON consumer never sees raw monotonic values)."""
+        ms = 1000.0
+        out: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "ttft_ms": round(self.ttft() * ms, 3),
+            "queue_ms": round(
+                max(self.admitted - self.enqueued, 0.0) * ms, 3
+            ) if self.admitted else 0.0,
+            "prefill_ms": round(
+                (self.prefill_end - self.prefill_start) * ms, 3
+            ) if self.prefill_end else 0.0,
+            "decode_ms": round(
+                max(self.harvested - self.prefill_end, 0.0) * ms, 3
+            ) if self.prefill_end else 0.0,
+            "total_ms": round(
+                max((self.responded or self.harvested) - self.received, 0.0)
+                * ms, 3
+            ),
+            "itl_mean_ms": round(self.itl_mean() * ms, 3),
+            "itl_min_ms": round(self.itl_min * ms, 3),
+            "itl_max_ms": round(self.itl_max * ms, 3),
+            "tokens": self.itl_count + 1 if self.first_token else 0,
+            "queue_reentries": self.queue_reentries,
+        }
+        if self.bucket is not None:
+            out["bucket"] = list(self.bucket)
+        if self.pages_reserved:
+            out["pages_reserved"] = self.pages_reserved
+            out["prefix_blocks_hit"] = self.prefix_blocks_hit
+            out["suffix_len"] = self.suffix_len
+        return out
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-engine-step records; the black box the
+    stall/chaos/poison dump paths read back. All appends happen on the
+    scheduler worker thread; ``snapshot()`` copies under the GIL, so the
+    HTTP ``/debug/state`` reader needs no lock."""
+
+    def __init__(self, steps: int = 256):
+        self.ring = deque(maxlen=max(int(steps), 1))
+        self.dumps = 0
+
+    def record(self, **fields) -> None:
+        self.ring.append(fields)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return list(self.ring)
+
+    def dump(self, reason: str, limit: int = 64) -> None:
+        """Print the last ``limit`` records to stderr (one JSON object
+        per line — grep-able next to the watchdog's stack dump)."""
+        records = self.snapshot()[-limit:]
+        self.dumps += 1
+        telemetry.inc("serve/flight_dumps")
+        print(
+            f"[trlx_tpu.serve] FLIGHT RECORDER ({reason}): last "
+            f"{len(records)} engine steps:",
+            file=sys.stderr, flush=True,
+        )
+        for rec in records:
+            print("[trlx_tpu.serve] " + json.dumps(rec), file=sys.stderr)
+        sys.stderr.flush()

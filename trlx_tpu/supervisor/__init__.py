@@ -139,6 +139,10 @@ class RunSupervisor:
         self.rescue_fn = rescue_fn
         self.exit_fn = exit_fn
 
+        #: extra state dumpers run alongside the stack dump on a stall
+        #: (add_dump_fn) — e.g. the slot scheduler's flight recorder, so
+        #: a stall shows the engine's last N steps, not just frames
+        self.dump_fns = []
         self.stalls = 0
         self.escalated = False
         self.stalled_phase: Optional[str] = None
@@ -294,7 +298,26 @@ class RunSupervisor:
         )
         print(header, file=sys.stderr, flush=True)
         self._dump_stacks()
+        self._run_dump_fns()
         self._flush_telemetry()
+
+    def add_dump_fn(self, fn: Callable[[], None]) -> None:
+        """Register an extra state dumper to run on every stall (after
+        the stack dump) — subsystems attach their black boxes here (the
+        serve flight recorder); a dumper that raises is reported and
+        skipped, never letting diagnostics kill containment."""
+        self.dump_fns.append(fn)
+
+    def _run_dump_fns(self) -> None:
+        for fn in self.dump_fns:
+            try:
+                fn()
+            except Exception as e:
+                print(
+                    f"[trlx_tpu] stall state dump {fn!r} failed ({e!r}); "
+                    f"continuing",
+                    file=sys.stderr, flush=True,
+                )
 
     def _dump_stacks(self) -> None:
         frames = sys._current_frames()

@@ -228,3 +228,14 @@ def observe(name: str, seconds: float) -> None:
 def summary() -> Dict[str, Any]:
     """The active session's run-level summary ({} when disabled)."""
     return _session.summary() if _session is not None else {}
+
+
+def prometheus_text() -> str:
+    """The active session's registry in Prometheus text exposition
+    format ("" when disabled) — the serve endpoint's content-negotiated
+    ``GET /metrics`` body (trlx_tpu.telemetry.prometheus)."""
+    if _session is None:
+        return ""
+    from trlx_tpu.telemetry.prometheus import render
+
+    return render(_session.registry)

@@ -1,0 +1,67 @@
+"""Prometheus text exposition for the metrics registry.
+
+``GET /metrics`` on the serve endpoint is content-negotiated: the JSON
+registry summary stays the default (the ``telemetry.json`` shape), and a
+scraper that sends ``Accept: text/plain`` (or names openmetrics/
+prometheus) gets this rendering instead — no adapter process between the
+endpoint and a Prometheus server. Mapping:
+
+- **counters** -> ``<name>_total`` with ``# TYPE ... counter``
+  (predeclared-but-never-incremented counters render as 0, so a
+  dashboard sees a zero series, not a missing one);
+- **gauges** -> ``<name>`` with ``# TYPE ... gauge``;
+- **timing histograms** -> Prometheus *summaries*: ``<name>_seconds``
+  quantile samples (p50/p95 over the registry's bounded window, the
+  same values the JSON summary reports), plus ``_sum`` / ``_count``.
+  An empty histogram renders sum/count 0 and quantiles 0.
+
+Metric names pass through :func:`sanitize` — the registry's ``/``
+namespacing (``serve/ttft``) becomes ``_`` and everything gets the
+``trlx_tpu_`` prefix, so ``serve/ttft`` scrapes as
+``trlx_tpu_serve_ttft_seconds{quantile="0.5"}``.
+"""
+
+import re
+
+from trlx_tpu.telemetry.registry import MetricsRegistry
+
+#: the exposition content type scrapers expect (text format 0.0.4)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """One registry key -> a valid Prometheus metric name."""
+    out = _INVALID.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return "trlx_tpu_" + out
+
+
+def _fmt(value: float) -> str:
+    return repr(float(value))
+
+
+def render(registry: MetricsRegistry) -> str:
+    """The full registry in Prometheus text exposition format."""
+    lines = []
+    for name in sorted(registry.counters):
+        metric = sanitize(name) + "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(registry.counters[name])}")
+    for name in sorted(registry.gauges):
+        metric = sanitize(name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_fmt(registry.gauges[name])}")
+    for name in sorted(registry.hists):
+        hist = registry.hists[name]
+        metric = sanitize(name) + "_seconds"
+        lines.append(f"# TYPE {metric} summary")
+        lines.append(f'{metric}{{quantile="0.5"}} {_fmt(hist.quantile(0.5))}')
+        lines.append(
+            f'{metric}{{quantile="0.95"}} {_fmt(hist.quantile(0.95))}'
+        )
+        lines.append(f"{metric}_sum {_fmt(hist.total)}")
+        lines.append(f"{metric}_count {_fmt(hist.count)}")
+    return "\n".join(lines) + "\n"

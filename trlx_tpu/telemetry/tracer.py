@@ -44,9 +44,14 @@ class SpanTracer:
         self.max_events = max_events
         self.clock = clock
         self.t0 = clock()
+        # anchor for externally-timestamped spans (add_span): serve-path
+        # request traces record time.monotonic (the supervisor's
+        # containment clock), so both clock domains need a common zero
+        self.t0_monotonic = time.monotonic()
         self.events = []
         self.dropped = 0
         self._seen = set()
+        self._named_tracks = set()
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -78,6 +83,45 @@ class SpanTracer:
                     self.registry.set_gauge(f"compile/{name}_first_s", dur)
                 if self.dropped == 1:
                     self.registry.inc("telemetry/trace_events_dropped")
+
+    def add_span(self, name: str, start_mono: float, end_mono: float,
+                 tid: int = 0, args=None) -> None:
+        """Append one complete event whose timestamps come from
+        ``time.monotonic`` (the supervisor's containment clock) rather
+        than a live ``span()`` context — the serve request traces export
+        their lifecycle phases through here, one Perfetto track (tid)
+        per request. Bounded by the same ``max_events`` budget."""
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            if self.registry is not None and self.dropped == 1:
+                self.registry.inc("telemetry/trace_events_dropped")
+            return
+        event = {
+            "name": name,
+            "ph": "X",
+            "ts": round((start_mono - self.t0_monotonic) * 1e6, 3),
+            "dur": round(max(end_mono - start_mono, 0.0) * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": int(tid),
+        }
+        if args:
+            event["args"] = dict(args)
+        self.events.append(event)
+
+    def name_track(self, tid: int, label: str) -> None:
+        """Label one tid with a Chrome-trace thread_name metadata event
+        (once per tid) so Perfetto shows e.g. ``req 3f2a...`` instead of
+        a bare integer."""
+        if tid in self._named_tracks or len(self.events) >= self.max_events:
+            return
+        self._named_tracks.add(tid)
+        self.events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": os.getpid(),
+            "tid": int(tid),
+            "args": {"name": label},
+        })
 
     def write_jsonl(self, path: str) -> str:
         """One Chrome-trace event per line. Perfetto loads the file as-is;

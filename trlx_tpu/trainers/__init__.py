@@ -8,6 +8,7 @@ state is functional: parameters and optimizer state are pytrees held by the
 trainer, stepped by jitted pure functions.
 """
 
+import sys
 from abc import abstractmethod
 from typing import Callable, Dict
 
@@ -523,6 +524,34 @@ class BaseRLTrainer:
             if mfu is not None:
                 out["throughput/mfu"] = mfu
         return out
+
+    def _maybe_flush_telemetry(self) -> None:
+        """Periodic telemetry flush (``train.telemetry_flush_every``):
+        rewrite ``run_dir/telemetry.json`` + ``trace.jsonl`` on an
+        iteration cadence so a SIGKILL'd run (which never reaches the
+        learn()-exit ``_finish_telemetry``) still leaves artifacts. Write
+        failures are reported, never raised — observability must not
+        kill training."""
+        from trlx_tpu import telemetry
+
+        every = int(getattr(self.config.train, "telemetry_flush_every", 0))
+        if every <= 0:
+            return
+        tel = telemetry.current()
+        if tel is None:
+            return
+        last = getattr(self, "_telemetry_flushed_at", 0)
+        if self.iter_count - last < every:
+            return
+        self._telemetry_flushed_at = self.iter_count
+        try:
+            tel.write()
+        except Exception as e:
+            print(
+                f"[trlx_tpu] periodic telemetry flush failed ({e!r}); "
+                f"continuing",
+                file=sys.stderr, flush=True,
+            )
 
     def _finish_telemetry(self, kind: str, clock=None) -> None:
         """learn()-exit hook: stamp the run's headline throughput and

@@ -593,6 +593,9 @@ class JaxILQLTrainer(BaseRLTrainer):
                 )
                 if saved_now:
                     self.save()
+                # periodic telemetry flush (train.telemetry_flush_every;
+                # no-op by default) so a SIGKILL still leaves artifacts
+                self._maybe_flush_telemetry()
                 if self._preempt(log_fn, guard, just_saved=saved_now,
                                  sup=sup):
                     return

@@ -808,6 +808,9 @@ class JaxPPOTrainer(BaseRLTrainer):
                         log_fn({"iter": self.iter_count, **ev})
                 if intervals["do_save"]:
                     self.save()
+                # periodic telemetry flush (train.telemetry_flush_every;
+                # no-op by default) so a SIGKILL still leaves artifacts
+                self._maybe_flush_telemetry()
                 if self._preempt(log_fn, guard,
                                  just_saved=intervals["do_save"],
                                  sup=sup):
