@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults telemetry chaos serve serve-soak
+.PHONY: style check test faults telemetry chaos serve serve-soak serve-chaos
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -59,15 +59,30 @@ chaos:
 # request-lifecycle observability layer (test_request_trace.py:
 # RequestTrace/TTFT/ITL semantics, Perfetto span export validity,
 # Prometheus /metrics exposition, /debug/state schema, flight-recorder
-# dumps on poisoned steps and watchdog stalls). Part of the non-slow
-# tier-1 set; this target runs just them. The slow-marked soak
-# (hundreds of mixed-length requests, zero recompiles, zero slot leaks)
-# is opt-in via `make serve-soak`.
+# dumps on poisoned steps and watchdog stalls), and the crash-only
+# serving lifecycle (test_lifecycle.py: restart-recovery greedy-parity
+# sweep across page sizes x kill points, deadline shed + priority
+# admission, graceful drain under load with 429 + Retry-After at the
+# door, live checkpoint hot-swap under load + probe rollback + LATEST
+# watcher). Part of the non-slow tier-1 set; this target runs just
+# them. The slow-marked soak (hundreds of mixed-length requests, zero
+# recompiles, zero slot leaks) is opt-in via `make serve-soak`; the
+# chaos lifecycle soak (injected poison/reload + a real-SIGTERM
+# subprocess drill) via `make serve-chaos`.
 serve:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
 		tests/test_slots.py tests/test_paged.py \
-		tests/test_request_trace.py -q -m 'not slow'
+		tests/test_request_trace.py tests/test_lifecycle.py \
+		-q -m 'not slow'
 
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \
 		tests/test_paged.py -q -m slow
+
+# crash-only lifecycle soak: waves of mixed traffic with injected
+# poisoned steps/admissions and a live hot-swap (zero lost requests,
+# zero page leaks, zero recompiles, clean drain), plus the subprocess
+# SIGTERM drill (in-flight work finishes, process exits 0)
+serve-chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py \
+		-q -m slow

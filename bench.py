@@ -1047,6 +1047,15 @@ def bench_serving(n_requests=96, trace_seed=17):
     ``serve_prefix_prefill_tokens_saved`` counts the skipped prefill
     tokens (the acceptance bar is >= 50% of all prompt tokens).
 
+    Leg 3 — chaos leg: the SAME shared-prefix trace replayed with a
+    poisoned decode step and a live hot-swap injected mid-flight (the
+    crash-only serving drill, docs "Fault tolerance"). Every request
+    must still complete — ``serve_recovered_requests`` counts the ones
+    that rode the replay path, ``serve_replay_prefill_tokens_saved``
+    the prefill tokens their re-admissions mapped copy-free through the
+    radix cache, and ``serve_chaos_vs_clean`` the tok/s the fault +
+    swap window cost against the clean prefix leg.
+
     Every leg also reports the request-lifecycle SLO metrics
     (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
     ``serve_itl_p50/p95_ms``, and the paged leg runs an extra
@@ -1058,6 +1067,7 @@ def bench_serving(n_requests=96, trace_seed=17):
     from trlx_tpu.data.configs import TRLConfig
     from trlx_tpu.serve import InferenceEngine, MicroBatcher, ServeConfig
     from trlx_tpu.serve.slots import SlotScheduler
+    from trlx_tpu.supervisor import chaos
 
     telemetry.start()
     config = TRLConfig.from_dict({
@@ -1232,6 +1242,56 @@ def bench_serving(n_requests=96, trace_seed=17):
         f"{prefix_stats['prefix_hit_rate']:.2f}, "
         f"{prefix_stats['evicted_pages']} pages evicted")
 
+    # chaos leg: same shared-prefix trace, but a poisoned decode step
+    # lands mid-trace (every live request re-queues and replays) and a
+    # hot-swap is requested while traffic is still flowing — the
+    # crash-only acceptance drill, measured instead of asserted
+    telemetry.start()
+    chaos_sched = SlotScheduler(prefix_engine)
+    chaos_sched.warmup()
+    chaos_sched.start()
+    try:
+        t0 = time.perf_counter()
+        half = len(prefix_trace) // 2
+        reqs = [chaos_sched.submit(t, max_new_tokens=mn)
+                for t, mn in prefix_trace[:half]]
+        # let the first wave commit its system prompts, then poison
+        while sum(r.done.is_set() for r in reqs) < max(half // 4, 1):
+            time.sleep(0.005)
+        t_fault = time.perf_counter()
+        chaos.configure("serve_decode:exc@1")
+        reqs += [chaos_sched.submit(t, max_new_tokens=mn)
+                 for t, mn in prefix_trace[half:]]
+        swap = chaos_sched.request_swap(
+            prefix_engine._init_params(), label="bench-hot-swap"
+        )
+        event_window_s = time.perf_counter() - t_fault
+        for r in reqs:
+            r.wait(timeout=600.0)
+        chaos_dt = time.perf_counter() - t0
+        chaos_tok_s = sum(len(r.result) for r in reqs) / chaos_dt
+        chaos_stats = chaos_sched.pool_stats()
+        recovered = [r for r in reqs if r.replays > 0]
+        replay_saved = sum(
+            r.trace.prefix_blocks_hit for r in recovered
+            if r.trace is not None
+        ) * prefix_engine.page_size_tokens()
+    finally:
+        chaos.reset()
+        chaos_sched.stop()
+    if not swap.get("reloaded"):
+        raise RuntimeError(f"chaos-leg hot-swap failed: {swap}")
+    lost = sum(1 for r in reqs if r.result is None)
+    if lost:
+        raise RuntimeError(f"chaos leg lost {lost} requests")
+    chaos_vs_clean = chaos_tok_s / max(prefix["tok_s"], 1e-9)
+    log(f"serve[chaos]:      {chaos_tok_s:,.1f} useful tok/s "
+        f"({chaos_vs_clean:.2f}x clean) with 1 poisoned step + 1 "
+        f"hot-swap in a {event_window_s:.1f}s event window; "
+        f"{len(recovered)}/{len(reqs)} requests recovered via replay, "
+        f"{replay_saved} replay prefill tokens mapped through the "
+        f"prefix cache, 0 lost")
+
     jax.block_until_ready(engine.blocks)
 
     def slo_keys(stats, suffix=""):
@@ -1283,6 +1343,19 @@ def bench_serving(n_requests=96, trace_seed=17):
             prefix_stats["prefix_hit_rate"], 3
         ),
         "serve_prefix_tokens_per_sec": round(prefix["tok_s"], 1),
+        # chaos leg: injected poisoned step + live hot-swap mid-trace
+        "serve_recovered_requests": len(recovered),
+        "serve_replay_prefill_tokens_saved": int(replay_saved),
+        "serve_chaos_tokens_per_sec": round(chaos_tok_s, 1),
+        "serve_chaos_vs_clean": round(chaos_vs_clean, 3),
+        "serve_chaos_event_window_s": round(event_window_s, 2),
+        "serve_chaos_model_version": int(swap["model_version"]),
+        "serve_chaos_workload": (
+            f"the shared-prefix trace with serve_decode:exc injected "
+            f"mid-trace (all live requests replay) and a hot-swap "
+            f"requested under load; zero lost requests is asserted, "
+            f"not reported"
+        ),
         "serve_mixed_workload": (
             f"{n_requests}-request burst, gpt2-124M geometry, prompts "
             f"2..16 tok, max_new skewed short over a 48-token gen "

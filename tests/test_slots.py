@@ -299,8 +299,8 @@ def test_stopped_scheduler_fails_pending(engine):
 def test_chaos_admit_hang_is_attributable_stall(engine, fresh_registry):
     """serve_admit:hang wedges the admission phase; the watchdog must
     attribute the stall to 'serve_admit' (not silence, not a misnamed
-    phase), and releasing the hang fails only that batch while the loop
-    keeps serving."""
+    phase), and releasing the hang replays the batch (crash-only
+    recovery) while the loop keeps serving."""
     exit_codes = []
     sup = RunSupervisor(
         stall_timeout=0.3, stall_first_timeout=0.3,
@@ -319,9 +319,11 @@ def test_chaos_admit_hang_is_attributable_stall(engine, fresh_registry):
         assert sup.stalled_phase == "serve_admit"
         assert fresh_registry.counters["fault/stalls"] >= 1.0
         chaos.reset()  # releases the hang as ChaosHang in the worker
-        with pytest.raises(chaos.ChaosHang):
-            req.wait(timeout=15.0)
-        assert fresh_registry.counters["serve/request_errors"] >= 1.0
+        # the released hang is an admission fault: the batch is
+        # RE-QUEUED for replay and completes once the seam is clear
+        assert req.wait(timeout=15.0).result is not None
+        assert req.replays == 1
+        assert fresh_registry.counters["serve/replays"] >= 1.0
         # the loop survived: a fresh request is admitted and decoded
         ok = s.submit([4, 5], max_new_tokens=2)
         assert ok.wait(timeout=30.0).result is not None
@@ -331,13 +333,19 @@ def test_chaos_admit_hang_is_attributable_stall(engine, fresh_registry):
         s.stop()
 
 
-def test_chaos_admit_exc_fails_batch_not_loop(engine, fresh_registry,
-                                              scheduler):
+def test_chaos_admit_exc_replays_batch_not_loop(engine, fresh_registry,
+                                                scheduler):
+    """A poisoned admission (serve_admit:exc) RE-QUEUES its batch for
+    replay instead of failing it (crash-only serving): the request
+    completes on the retried admission, bit-identical."""
     chaos.configure("serve_admit:exc@1")
     try:
         req = scheduler.submit([1, 2], max_new_tokens=2)
-        with pytest.raises(chaos.ChaosError):
-            req.wait(timeout=30.0)
+        assert req.wait(timeout=30.0).result is not None
+        oracle = direct_generate(engine, [[1, 2]], (2, 8, 8))
+        assert req.result == engine.depad_row(oracle, 0, 2)
+        assert req.replays == 1
+        assert fresh_registry.counters["serve/replays"] >= 1.0
         assert scheduler.free_slots() == scheduler.runtime.num_slots
         ok = scheduler.submit([3, 4], max_new_tokens=2)
         assert ok.wait(timeout=30.0).result is not None
@@ -345,12 +353,56 @@ def test_chaos_admit_exc_fails_batch_not_loop(engine, fresh_registry,
         chaos.reset()
 
 
-def test_poisoned_step_fails_live_and_recovers(engine, fresh_registry,
-                                               scheduler):
-    """A decode-step failure (serve_decode:exc) fails the in-flight
-    requests, resets the lanes, and the next request serves normally —
-    the slots twin of the batcher's poisoned-batch containment."""
+def test_poisoned_step_replays_live_and_recovers(engine, fresh_registry,
+                                                 scheduler):
+    """A decode-step failure (serve_decode:exc) resets the lanes and
+    RE-QUEUES the in-flight requests instead of failing them — the
+    replayed request finishes with output bit-identical to an
+    uninterrupted run (the greedy-parity invariant makes replay safe),
+    and the loop keeps serving."""
     chaos.configure("serve_decode:exc@1")
+    try:
+        req = scheduler.submit([1, 2], max_new_tokens=4)
+        assert req.wait(timeout=30.0).result is not None
+        oracle = direct_generate(engine, [[1, 2]], (2, 8, 8))
+        assert req.result == engine.depad_row(oracle, 0, 4)
+        assert req.replays == 1
+        assert fresh_registry.counters["serve/replays"] >= 1.0
+        assert fresh_registry.counters.get("serve/request_errors", 0) == 0
+        assert scheduler.free_slots() == scheduler.runtime.num_slots
+        ok = scheduler.submit([3, 4], max_new_tokens=2)
+        assert ok.wait(timeout=30.0).result is not None
+    finally:
+        chaos.reset()
+
+
+def test_replay_budget_exhaustion_is_typed_503(engine, fresh_registry,
+                                               scheduler):
+    """Every step poisoned (serve_decode:exc@*): the request burns its
+    full ``serve.max_replays`` budget and completes with the typed
+    ReplayExhausted (HTTP 503 + reason), not a raw ChaosError — and the
+    engine still serves once the fault clears."""
+    from trlx_tpu.serve.batcher import ReplayExhausted
+
+    chaos.configure("serve_decode:exc@*")
+    try:
+        req = scheduler.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(ReplayExhausted, match="max_replays"):
+            req.wait(timeout=30.0)
+        assert req.replays == engine.serve.max_replays + 1
+    finally:
+        chaos.reset()
+    assert scheduler.free_slots() == scheduler.runtime.num_slots
+    ok = scheduler.submit([3, 4], max_new_tokens=2)
+    assert ok.wait(timeout=30.0).result is not None
+
+
+def test_replay_double_fault_falls_back_to_fail(engine, fresh_registry,
+                                                scheduler):
+    """A fault INSIDE recovery itself (serve_replay:exc) is a double
+    fault: replay is abandoned and the batch fails like pre-replay
+    containment — never a wedged loop."""
+    chaos.configure("serve_decode:exc@1;serve_replay:exc@1")
     try:
         req = scheduler.submit([1, 2], max_new_tokens=4)
         with pytest.raises(chaos.ChaosError):

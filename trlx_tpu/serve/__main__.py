@@ -82,6 +82,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-recorder-steps", type=int, default=None,
                    help="engine-step black-box ring size dumped on "
                         "stalls and served at /debug/state (0 = off)")
+    p.add_argument("--max-replays", type=int, default=None,
+                   help="crash-only replay budget per request: poisoned "
+                        "steps re-queue in-flight requests this many "
+                        "times before a 503 (0 = fail on first fault)")
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   help="graceful-drain budget (SIGTERM, POST "
+                        "/admin/drain): in-flight requests past it are "
+                        "shed with 503 + reason")
+    p.add_argument("--watch-checkpoints", type=float, default=None,
+                   help="poll the run dir's LATEST every N seconds and "
+                        "hot-swap new checkpoints live (0 = off; "
+                        "POST /admin/reload always works)")
+    p.add_argument("--degrade-step-ms", type=float, default=None,
+                   help="adaptive admission: halve the queue bound "
+                        "while a decode step exceeds this (0 = off)")
     p.add_argument("--no-request-tracing", action="store_true",
                    help="disable per-request lifecycle tracing (the "
                         "serve/ttft|itl|goodput SLO family and the "
@@ -113,7 +128,11 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("page_size", "page_size"),
                        ("pages", "pages"),
                        ("slo_ttft_ms", "slo_ttft_ms"),
-                       ("flight_recorder_steps", "flight_recorder_steps")):
+                       ("flight_recorder_steps", "flight_recorder_steps"),
+                       ("max_replays", "max_replays"),
+                       ("drain_timeout", "drain_timeout"),
+                       ("watch_checkpoints", "watch_checkpoints"),
+                       ("degrade_step_ms", "degrade_step_ms")):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, attr, value)

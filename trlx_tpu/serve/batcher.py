@@ -42,9 +42,10 @@ Metrics (trlx_tpu.telemetry): ``serve/queue_depth`` gauge,
 counters.
 """
 
+import itertools
 import threading
 from collections import deque
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from trlx_tpu import supervisor, telemetry
 from trlx_tpu.serve.trace import RequestTrace
@@ -56,16 +57,93 @@ class QueueFull(RuntimeError):
     Clients should back off and retry (HTTP 429)."""
 
 
+class Draining(QueueFull):
+    """Admission rejection because the server is draining (SIGTERM or
+    ``POST /admin/drain``): retry against another replica (HTTP 429 +
+    ``Retry-After``). IS-A :class:`QueueFull` so scheduler-agnostic
+    callers handle both the same way."""
+
+
+class ReplayExhausted(RuntimeError):
+    """A request's crash-only replay budget (``serve.max_replays``) ran
+    out, or its grown prompt (original + committed tokens) no longer
+    fits any compiled bucket — the request cannot be re-executed and
+    fails with a typed reason (HTTP 503)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's own ``deadline_ms`` passed while it was still
+    queued — shed by overload control instead of decoded uselessly
+    (HTTP 503, ``serve/shed_expired``)."""
+
+
+class DrainTimeout(RuntimeError):
+    """The graceful-drain budget (``serve.drain_timeout``) expired with
+    this request still unfinished; it is shed with a reason instead of
+    killed with the process (HTTP 503)."""
+
+
+#: global admission order: ties in priority admit FIFO by this stamp,
+#: and replayed requests keep their original position
+_SEQ = itertools.count()
+
+
+def _validate_deadline(deadline_ms) -> Optional[float]:
+    """HTTP ``deadline_ms`` -> seconds (None passes through); <= 0 is a
+    request that could never be served, a caller bug (HTTP 400)."""
+    if deadline_ms is None:
+        return None
+    deadline_ms = float(deadline_ms)
+    if deadline_ms <= 0:
+        raise ValueError(
+            f"deadline_ms={deadline_ms:g} must be > 0 (the deadline is "
+            f"relative to request receipt)"
+        )
+    return deadline_ms / 1000.0
+
+
+def shed_expired(requests, now: float) -> List["Request"]:
+    """Split off requests whose deadline passed while queued, failing
+    each with :class:`DeadlineExceeded` (+ ``serve/shed_expired``);
+    returns the survivors in order. Shared by both schedulers."""
+    kept = []
+    for req in requests:
+        if req.deadline_at is not None and now > req.deadline_at:
+            telemetry.inc("serve/shed_expired")
+            telemetry.inc("serve/request_errors")
+            req.error = DeadlineExceeded(
+                f"request shed: its deadline_ms passed after "
+                f"{(now - req.enqueued_at) * 1000.0:.0f}ms in queue "
+                f"(overload — see serve/queue_depth and "
+                f"serve/shed_expired)"
+            )
+            req.done.set()
+        else:
+            kept.append(req)
+    return kept
+
+
 class Request:
-    """One queued generation request and its completion slot."""
+    """One queued generation request and its completion slot.
+
+    Crash-only recovery journal: ``committed`` holds the tokens already
+    harvested host-side — on a poisoned step the request is re-queued
+    with them instead of failed, and re-admission prefills
+    ``tokens + committed`` to resume decode from the last committed
+    token (greedy decode is Markov on the token prefix, so the
+    continuation is bit-identical). ``replays`` counts those re-queues
+    against ``serve.max_replays``."""
 
     __slots__ = ("tokens", "max_new_tokens", "seed", "shape",
                  "enqueued_at", "done", "result", "error", "latency_s",
-                 "trace")
+                 "trace", "seq", "priority", "deadline_at", "replays",
+                 "committed", "model_version")
 
     def __init__(self, tokens: List[int], max_new_tokens: int,
                  shape, seed: Optional[int] = None,
-                 trace: Optional[RequestTrace] = None):
+                 trace: Optional[RequestTrace] = None,
+                 deadline_s: Optional[float] = None,
+                 priority: int = 0):
         self.tokens = tokens
         self.max_new_tokens = max_new_tokens
         self.seed = seed
@@ -76,8 +154,22 @@ class Request:
         self.error: Optional[BaseException] = None
         self.latency_s: float = 0.0
         self.trace = trace
+        self.seq = next(_SEQ)
+        self.priority = int(priority)
+        self.deadline_at = (
+            None if deadline_s is None else self.enqueued_at + deadline_s
+        )
+        self.replays = 0
+        self.committed: List[int] = []
+        self.model_version = 0  # stamped at admission
         if trace is not None:
             trace.enqueued = self.enqueued_at
+
+    def remaining_new_tokens(self) -> int:
+        """Decode budget still owed after the committed prefix — always
+        >= 1 for a live/queued request (a request whose last token was
+        committed finished at that same harvest)."""
+        return self.max_new_tokens - len(self.committed)
 
     def wait(self, timeout: Optional[float] = None) -> "Request":
         """Block until decoded; re-raises the worker-side error if the
@@ -115,6 +207,8 @@ class MicroBatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._batch_counter = 0
+        self._draining = False
+        self._inflight = 0  # requests inside the current _flush
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -150,11 +244,16 @@ class MicroBatcher:
 
     def submit(self, tokens: List[int], max_new_tokens: Optional[int] = None,
                seed: Optional[int] = None,
-               trace: Optional[RequestTrace] = None) -> Request:
+               trace: Optional[RequestTrace] = None,
+               deadline_ms: Optional[float] = None,
+               priority: int = 0) -> Request:
         """Enqueue one request (bucket-rounded); raises ValueError when
-        no lattice bucket fits, QueueFull past ``max_queue``. An explicit
-        ``trace`` (the HTTP layer's, carrying ``received``) is attached
-        as-is; otherwise one is minted here when tracing is on."""
+        no lattice bucket fits, QueueFull past ``max_queue``, Draining
+        during a graceful drain. An explicit ``trace`` (the HTTP layer's,
+        carrying ``received``) is attached as-is; otherwise one is minted
+        here when tracing is on. ``deadline_ms`` bounds total queueing:
+        a request still queued past it is shed with
+        :class:`DeadlineExceeded` (the static path checks at flush)."""
         if not tokens:
             raise ValueError("empty prompt: at least one token is required")
         if max_new_tokens is None:
@@ -162,12 +261,21 @@ class MicroBatcher:
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
+        deadline_s = _validate_deadline(deadline_ms)
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
         if trace is None and self._tracing:
             trace = RequestTrace()
         req = Request(list(tokens), max_new_tokens, shape, seed=seed,
-                      trace=trace)
+                      trace=trace, deadline_s=deadline_s,
+                      priority=priority)
         with self._cond:
+            if self._draining:
+                telemetry.inc("serve/rejected")
+                raise Draining(
+                    "server is draining: admission is closed while "
+                    "in-flight requests finish (serve.drain_timeout); "
+                    "retry against another replica"
+                )
             if len(self._queue) >= self.max_queue:
                 telemetry.inc("serve/rejected")
                 raise QueueFull(
@@ -214,6 +322,14 @@ class MicroBatcher:
             return []
 
     def _flush(self, batch: List[Request]) -> None:
+        batch = shed_expired(batch, monotonic())
+        if not batch:
+            return
+        version = self.engine.model_version
+        for r in batch:
+            r.model_version = version
+            if r.trace is not None:
+                r.trace.model_version = version
         shape = batch[0].shape
         sizes = self.engine.batch_sizes_for(shape)
         B = next(b for b in sizes if b >= len(batch))
@@ -281,6 +397,7 @@ class MicroBatcher:
                 batch = self._take_batch()
                 if not batch:
                     continue
+                self._inflight = len(batch)
                 try:
                     self._flush(batch)
                 except Exception as e:
@@ -290,3 +407,113 @@ class MicroBatcher:
                     for req in batch:
                         req.error = e
                         req.done.set()
+                finally:
+                    self._inflight = 0
+                    with self._cond:
+                        self._cond.notify_all()  # wake a waiting drain
+
+    # -- crash-only lifecycle (docs "Fault tolerance") ------------------- #
+
+    def retry_after_s(self) -> int:
+        """The 429 ``Retry-After`` hint: current queue depth paced by
+        the recent request-latency p50 over the average batch extent —
+        the static-path analogue of the slot scheduler's queue-depth x
+        step-p50 estimate. Never below 1s."""
+        depth = len(self._queue)
+        per_req = 0.05
+        tel = telemetry.current()
+        if tel is not None:
+            hist = tel.registry.hists.get("serve/request_latency")
+            if hist is not None and hist.count:
+                per_req = max(hist.quantile(0.5), 1e-3)
+        mean_batch = max(
+            sum(b for b, _, _ in self.engine.buckets)
+            / len(self.engine.buckets), 1.0,
+        )
+        return max(1, int(-(-depth * per_req // mean_batch)))
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: close admission (submit -> :class:`Draining`),
+        let queued + in-flight requests finish within ``timeout``
+        (default ``serve.drain_timeout``), shed leftovers with
+        :class:`DrainTimeout`, stop the worker. Returns True when
+        everything finished inside the budget."""
+        if timeout is None:
+            timeout = float(self.engine.serve.drain_timeout)
+        with self._cond:
+            first = not self._draining
+            self._draining = True
+        if first:
+            telemetry.inc("serve/drains")
+        deadline = monotonic() + timeout
+        clean = True
+        with self._cond:
+            while self._queue or self._inflight:
+                remaining = deadline - monotonic()
+                if remaining <= 0:
+                    clean = False
+                    break
+                self._cond.wait(timeout=min(remaining, 0.1))
+        if not clean:
+            with self._cond:
+                pending = list(self._queue)
+                self._queue.clear()
+            telemetry.inc("serve/request_errors", len(pending))
+            for req in pending:
+                req.error = DrainTimeout(
+                    f"server drained: request shed after the "
+                    f"{timeout:.3g}s serve.drain_timeout budget expired "
+                    f"with it still queued; retry against another replica"
+                )
+                req.done.set()
+        self.stop()
+        return clean
+
+    def request_swap(self, params, label: str = "") -> Dict:
+        """Live checkpoint hot-swap for the static path: validate the
+        candidate tree, smoke-probe it by running the smallest compiled
+        bucket DIRECTLY against the candidate views (the executables take
+        weights as arguments, so probing needs no install), then install
+        under the engine dispatch lock — atomic w.r.t. in-flight decodes,
+        zero recompiles. Returns the reload verdict dict; a failed probe
+        rolls back by never installing."""
+        import threading as _threading
+
+        import jax
+        import numpy as np
+
+        chaos.maybe_inject("serve_reload")
+        e = self.engine
+        views = e.strip_for_serve(params)
+        e.validate_swap(views)
+        old_version = e.model_version
+        bucket = e.buckets[0]
+        B, P, _ = bucket
+        tokens = np.full((B, P), e.pad_token_id, np.int32)
+        tokens[:, -1] = 0
+        mask = np.zeros((B, P), np.int32)
+        mask[:, -1] = 1
+        detail = ""
+        try:
+            out = e._decode_fn(bucket)(
+                *views, tokens, mask, jax.random.PRNGKey(0)
+            )
+            probe = np.asarray(jax.device_get(out.gen_logprobs))
+            ok = bool(np.all(np.isfinite(probe)))
+            if not ok:
+                detail = "smoke probe produced non-finite logprobs"
+        except Exception as exc:
+            ok = False
+            detail = f"smoke probe failed: {exc!r}"
+        if not ok:
+            telemetry.inc("serve/reload_failures")
+            return {"reloaded": False, "model_version": old_version,
+                    "reason": detail}
+        if e._lock is None:
+            e._lock = _threading.Lock()
+        with e._lock:  # no decode mid-dispatch sees a torn weight set
+            e.install_views(views)
+        e.commit_version(label or None)
+        telemetry.inc("serve/reloads")
+        return {"reloaded": True, "model_version": e.model_version,
+                "previous_version": old_version}

@@ -118,6 +118,30 @@ class ServeConfig:
         pages_free, admissions/evictions, step walltime); dumped on
         watchdog stalls, chaos firings, and poisoned-step resets, and
         served live at ``GET /debug/state``. 0 disables.
+    :param max_replays: per-request replay budget for crash-only
+        recovery (trlx_tpu.serve.slots): a poisoned step or admission
+        re-queues its in-flight requests — committed tokens kept
+        host-side, decode resumed suffix-only through the prefix cache —
+        up to this many times; past the budget the request fails with a
+        typed 503 instead of retrying forever against a deterministic
+        fault. 0 disables replay (every poisoned step fails its
+        requests, the pre-recovery behavior).
+    :param drain_timeout: graceful-drain budget (SIGTERM or
+        ``POST /admin/drain``): admission flips to 429+``Retry-After``,
+        in-flight and already-queued requests get this many seconds to
+        finish, leftovers are shed with a typed 503, telemetry and the
+        flight recorder flush, and the process exits 0.
+    :param watch_checkpoints: poll interval (seconds) for live
+        checkpoint hot-swap — the server watches the serving run dir's
+        ``LATEST`` marker and reloads new committed ``step_<N>``
+        checkpoints in place (same-sharding weight install, smoke probe,
+        rollback on failure, zero recompiles). 0 (default) disables
+        polling; ``POST /admin/reload`` works either way.
+    :param degrade_step_ms: adaptive-admission step-time threshold — a
+        decode step slower than this marks the scheduler degraded, which
+        halves the effective queue bound (on top of the always-on
+        degradation signals: slot/page starvation). 0 disables the
+        step-time signal.
     """
 
     buckets: List[List[int]] = field(
@@ -138,6 +162,10 @@ class ServeConfig:
     request_tracing: bool = True
     slo_ttft_ms: float = 500.0
     flight_recorder_steps: int = 256
+    max_replays: int = 2
+    drain_timeout: float = 30.0
+    watch_checkpoints: float = 0.0
+    degrade_step_ms: float = 0.0
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -233,6 +261,26 @@ class InferenceEngine:
                 f"{self.serve.flight_recorder_steps} must be >= 0 "
                 f"(0 = disabled)"
             )
+        if self.serve.max_replays < 0:
+            raise ValueError(
+                f"serve.max_replays={self.serve.max_replays} must be >= 0 "
+                f"(0 = a poisoned step fails its requests, no replay)"
+            )
+        if self.serve.drain_timeout <= 0:
+            raise ValueError(
+                f"serve.drain_timeout={self.serve.drain_timeout} must be "
+                f"> 0 (a drain with no budget is just SIGKILL)"
+            )
+        if self.serve.watch_checkpoints < 0:
+            raise ValueError(
+                f"serve.watch_checkpoints={self.serve.watch_checkpoints} "
+                f"must be >= 0 (0 = no polling; POST /admin/reload only)"
+            )
+        if self.serve.degrade_step_ms < 0:
+            raise ValueError(
+                f"serve.degrade_step_ms={self.serve.degrade_step_ms} "
+                f"must be >= 0 (0 = step-time degradation signal off)"
+            )
         self.buckets = _normalize_buckets(self.serve.buckets)
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)
 
@@ -256,6 +304,11 @@ class InferenceEngine:
         )
         self._trunk = trunk
         self.blocks = self.embed = self.ln_f = None
+        #: monotonically-increasing weight generation: 1 at construction,
+        #: bumped by commit_version() on each successful hot-swap; stamped
+        #: into every request at admission (``serve/model_version`` gauge)
+        self.model_version = 1
+        self.checkpoint_path: Optional[str] = None
         if params is not None:
             self._install_params(params)
         elif init:
@@ -397,6 +450,106 @@ class InferenceEngine:
         )
         self._decode_fns = {}  # shapes unchanged but weights swapped
         self.warmed = False
+
+    # -- live hot-swap (crash-only serving; docs "Fault tolerance") ------- #
+
+    def strip_for_serve(self, params: Dict):
+        """Reduce a full hydra tree to the decode views — the hot-swap
+        analogue of :meth:`_install_params`'s strip, WITHOUT installing:
+        the candidate weights must pass :meth:`validate_swap` and a smoke
+        probe before they replace the serving set."""
+        blocks = self.policy.all_blocks(params)
+        embed, ln_f = self.policy.head_params_for_decode(params)
+        return blocks, embed, ln_f
+
+    def validate_swap(self, views) -> None:
+        """Reject architecture drift BEFORE touching the serving weights:
+        a hot-swap candidate must match the installed views leaf-for-leaf
+        in structure, shape, and dtype — anything else would invalidate
+        the compiled executables (the ``compile/recompiles == 0``
+        invariant) and needs a restart, not a reload."""
+        import jax
+
+        old = (self.blocks, self.embed, self.ln_f)
+        old_struct = jax.tree_util.tree_structure(old)
+        new_struct = jax.tree_util.tree_structure(views)
+        if old_struct != new_struct:
+            raise ValueError(
+                "hot-swap rejected: candidate param tree structure does "
+                "not match the serving policy (architecture drift — e.g. "
+                "a different model or num_layers_unfrozen). Restart the "
+                "endpoint against the new checkpoint instead."
+            )
+        for o, n in zip(jax.tree_util.tree_leaves(old),
+                        jax.tree_util.tree_leaves(views)):
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise ValueError(
+                    f"hot-swap rejected: candidate leaf {n.shape}/"
+                    f"{n.dtype} does not match serving leaf {o.shape}/"
+                    f"{o.dtype} — shape/dtype drift would force a "
+                    f"recompile; restart the endpoint instead."
+                )
+
+    def install_views(self, views) -> None:
+        """Install pre-stripped (blocks, embed, ln_f) decode views
+        WITHOUT resetting the compiled executables — the hot-swap path.
+        Each new leaf is placed with the OLD leaf's sharding
+        (``jax.device_put`` onto the same layout, after which the old
+        buffers are unreferenced and freed), so the swap never changes
+        what the AOT executables were compiled against; the compiled fns
+        take the views as arguments, not captures, so new values flow
+        through with zero recompiles. Callers must have run
+        :meth:`validate_swap` first."""
+        import jax
+
+        def put(new, old):
+            try:
+                return jax.device_put(new, old.sharding)
+            except (AttributeError, ValueError):
+                return new  # host array / shardless leaf: use as-is
+
+        blocks, embed, ln_f = views
+        self.blocks = jax.tree_util.tree_map(put, blocks, self.blocks)
+        self.embed = jax.tree_util.tree_map(put, embed, self.embed)
+        self.ln_f = jax.tree_util.tree_map(put, ln_f, self.ln_f)
+
+    def commit_version(self, checkpoint: Optional[str] = None) -> int:
+        """Bump the model version AFTER a successful swap+probe (the
+        scheduler calls this at its step boundary); a rolled-back swap
+        never commits, so the gauge always names the weights actually
+        serving."""
+        from trlx_tpu import telemetry
+
+        self.model_version += 1
+        if checkpoint:
+            self.checkpoint_path = checkpoint
+        telemetry.set_gauge("serve/model_version", self.model_version)
+        return self.model_version
+
+    def load_params(self, checkpoint: str):
+        """Restore a full params tree for hot-swap: (params, resolved
+        checkpoint dir). ``checkpoint`` may be a committed checkpoint dir
+        or a run dir (the newest valid ``step_<N>`` is used). The
+        restore template is a throwaway re-init — transient host/device
+        memory during the reload, never retained."""
+        from trlx_tpu.utils.checkpoint import (
+            find_latest_checkpoint,
+            is_valid_checkpoint,
+            restore_components,
+        )
+
+        resolved = checkpoint if is_valid_checkpoint(checkpoint) \
+            else find_latest_checkpoint(checkpoint)
+        if resolved is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint at '{checkpoint}' to reload "
+                f"from (expected a checkpoint dir or a run dir of "
+                f"'step_<N>' checkpoints)"
+            )
+        restored = restore_components(
+            {"params": self._init_params()}, resolved
+        )
+        return restored["params"], resolved
 
     # -- bucket lattice -------------------------------------------------- #
 

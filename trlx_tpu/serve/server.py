@@ -31,23 +31,50 @@ of the codebase's stdlib-only host layer. Four routes:
   occupancy map (trace ids, emitted-token counts, page counts), the
   flight-recorder ring, and the KV pool/radix stats. The slot
   scheduler's black box, readable BEFORE a stall forces a dump.
+- ``GET /readyz`` — READINESS, split from /healthz liveness: 200 only
+  while the server is warmed AND admitting (503 once draining), so an
+  orchestrator rotates the replica out of the pool while /healthz stays
+  green and in-flight work finishes.
+- ``POST /admin/drain`` — graceful shutdown (also wired to SIGTERM):
+  admission flips to 429 + ``Retry-After``, in-flight requests finish
+  within ``serve.drain_timeout`` (stragglers complete with 503 +
+  reason), telemetry and the flight recorder flush, the process exits
+  0. Returns 202 immediately; poll /readyz.
+- ``POST /admin/reload`` — live checkpoint hot-swap (docs "Fault
+  tolerance"): body ``{"checkpoint": path?}`` (default: re-resolve the
+  serving run directory's ``LATEST``); the new params restore into
+  same-sharding buffers, smoke-probe one bucket, and swap at a step
+  boundary — rollback + 409 on probe failure, zero recompiles either
+  way. ``serve.watch_checkpoints`` > 0 polls ``LATEST`` and reloads
+  automatically.
 
 Request handling runs through :func:`trlx_tpu.supervisor.bounded_call`
 (``serve.request_timeout``): a request wedged behind a hung decode
 raises SeamTimeout in the handler (503 + ``fault/seam_timeouts``)
 instead of holding the socket forever. The ``serve_request`` chaos seam
 fires at handler entry so the error path is drillable
-(``serve_request:exc`` -> HTTP 500 with the injected error).
+(``serve_request:exc`` -> HTTP 500 with the injected error). 429s carry
+``Retry-After`` (queue depth x recent step p50); replay-budget
+exhaustion, queued-past-deadline sheds, and drain-deadline sheds map to
+503 with their reason strings.
 """
 
 import json
+import os
+import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from trlx_tpu import telemetry
-from trlx_tpu.serve.batcher import MicroBatcher, QueueFull
+from trlx_tpu.serve.batcher import (
+    DeadlineExceeded,
+    DrainTimeout,
+    MicroBatcher,
+    QueueFull,
+    ReplayExhausted,
+)
 from trlx_tpu.serve.trace import SLO_COUNTERS, RequestTrace
 from trlx_tpu.supervisor import (
     RunSupervisor,
@@ -77,6 +104,15 @@ _SERVE_COUNTERS = (
     # allocation pressure
     "serve/prefix_tokens_saved",
     "serve/evicted_pages",
+    # crash-only lifecycle family (docs "Fault tolerance"): in-flight
+    # requests re-queued after a poisoned step, queued requests shed past
+    # their deadline, graceful drains entered, checkpoint hot-swaps
+    # committed / rolled back
+    "serve/replays",
+    "serve/shed_expired",
+    "serve/drains",
+    "serve/reloads",
+    "serve/reload_failures",
 )
 
 
@@ -144,6 +180,17 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._json(200, telemetry.summary())
+        elif self.path == "/readyz":
+            # readiness is admission: a draining (or not-yet-warmed)
+            # replica answers 503 here while /healthz stays 200, so the
+            # orchestrator rotates it without killing in-flight work
+            ready = srv.warmed and not srv.draining
+            self._json(200 if ready else 503, {
+                "ready": ready,
+                "warmed": srv.warmed,
+                "draining": srv.draining,
+                "model_version": srv.engine.model_version,
+            })
         elif self.path == "/debug/state":
             state_fn = getattr(srv.batcher, "debug_state", None)
             if state_fn is not None:
@@ -156,13 +203,11 @@ class _Handler(BaseHTTPRequestHandler):
                     "flight_recorder": [],
                 })
         else:
-            self._error(404, f"no route '{self.path}' (have /generate "
-                             f"[POST], /healthz, /metrics, /debug/state)")
+            self._error(404, f"no route '{self.path}' (have /generate, "
+                             f"/admin/drain, /admin/reload [POST], "
+                             f"/healthz, /readyz, /metrics, /debug/state)")
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
-        if self.path != "/generate":
-            self._error(404, f"no POST route '{self.path}'")
-            return
         srv = self.server_ref
         # the trace clock starts at the HTTP edge, before body parsing;
         # an inbound X-Request-Id becomes the trace id (client log join)
@@ -176,6 +221,31 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, f"bad JSON body: {e}")
             return
+        if self.path == "/admin/drain":
+            srv.begin_drain()
+            self._json(202, {
+                "draining": True,
+                "drain_timeout": srv.engine.serve.drain_timeout,
+            })
+            return
+        if self.path == "/admin/reload":
+            try:
+                result = srv.reload(body.get("checkpoint"))
+            except (FileNotFoundError, ValueError) as e:
+                self._error(400, str(e))
+                return
+            except Exception as e:
+                telemetry.inc("serve/reload_failures")
+                self._error(500, f"{type(e).__name__}: {e}")
+                return
+            # probe failure / concurrent reload: weights unchanged, the
+            # old version keeps serving — a conflict, not a crash
+            self._json(200 if result.get("reloaded") else 409, result)
+            return
+        if self.path != "/generate":
+            self._error(404, f"no POST route '{self.path}' (have "
+                             f"/generate, /admin/drain, /admin/reload)")
+            return
         try:
             payload = bounded_call(
                 lambda: srv.handle_generate(
@@ -185,10 +255,20 @@ class _Handler(BaseHTTPRequestHandler):
                 label="serve_request",
             )
         except QueueFull as e:
-            self._error(429, str(e))
+            # admission control (queue full OR draining): tell the
+            # client WHEN to come back — queue depth x recent step p50
+            self._json(429, {"error": str(e)}, headers={
+                "Retry-After": str(srv.batcher.retry_after_s()),
+            })
             return
         except (ValueError, TypeError) as e:
             self._error(400, str(e))
+            return
+        except (ReplayExhausted, DeadlineExceeded, DrainTimeout) as e:
+            # the request itself is fine — the SERVICE could not finish
+            # it (replay budget spent, queued past deadline, drain
+            # deadline): 503 with the reason, safe to retry elsewhere
+            self._error(503, str(e))
             return
         except (SeamTimeout, TimeoutError) as e:
             self._error(503, str(e))
@@ -248,6 +328,22 @@ class InferenceServer:
             sup.add_dump_fn(dump_fn)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
+        self._stop_lock = threading.Lock()
+        # -- crash-only lifecycle (docs "Fault tolerance") -------------- #
+        self._lifecycle_lock = threading.Lock()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_done = threading.Event()
+        self._drain_clean = False
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_last_tried: Optional[str] = None
+
+    @property
+    def draining(self) -> bool:
+        """Admission state for /readyz: True once a drain has begun
+        (SIGTERM or POST /admin/drain), from the moment of entry."""
+        return self._drain_thread is not None \
+            or bool(getattr(self.batcher, "_draining", False))
 
     @property
     def warmed(self) -> bool:
@@ -276,6 +372,7 @@ class InferenceServer:
                              "(token-id list)")
         max_new = body.get("max_new_tokens")
         seed = body.get("seed")
+        deadline_ms = body.get("deadline_ms")
         trace = None
         if self.engine.serve.request_tracing:
             trace = RequestTrace(trace_id=trace_id, received=received_at)
@@ -283,6 +380,8 @@ class InferenceServer:
             tokens, max_new_tokens=max_new,
             seed=None if seed is None else int(seed),
             trace=trace,
+            deadline_ms=None if deadline_ms is None else float(deadline_ms),
+            priority=int(body.get("priority", 0)),
         )
         req.wait()  # bounded by the caller's bounded_call
         payload = {
@@ -293,6 +392,7 @@ class InferenceServer:
             "bucket": list(req.shape),
             "latency_ms": round(req.latency_s * 1000.0, 3),
             "queue_depth": self.batcher.queue_depth(),
+            "model_version": req.model_version,
         }
         if req.trace is not None:
             req.trace.responded = monotonic()
@@ -300,6 +400,110 @@ class InferenceServer:
             if body.get("trace"):
                 payload["trace"] = req.trace.to_dict()
         return payload
+
+    # -- graceful drain --------------------------------------------------- #
+
+    def begin_drain(self) -> None:
+        """Start a graceful drain without blocking the caller (SIGTERM
+        handlers and the /admin/drain route must return immediately):
+        admission flips to 429 now; a background thread finishes the
+        in-flight work, flushes telemetry, and tears the server down.
+        Idempotent."""
+        with self._lifecycle_lock:
+            if self._drain_thread is not None:
+                return
+            self._drain_thread = threading.Thread(
+                target=self._do_drain, name="trlx-serve-drain", daemon=True
+            )
+            self._drain_thread.start()
+
+    def _do_drain(self) -> None:
+        try:
+            # scheduler-level drain: rejects new work, finishes (or
+            # deadline-sheds) everything in flight, dumps the flight
+            # recorder, stops the worker
+            self._drain_clean = self.batcher.drain()
+        finally:
+            self._watch_stop.set()
+            try:
+                tel = telemetry.current()
+                if tel is not None:
+                    tel.write()  # the post-mortem must not lose metrics
+            except Exception as e:
+                print(f"[trlx_tpu.serve] telemetry flush failed during "
+                      f"drain: {e!r}", file=sys.stderr, flush=True)
+            self.stop()
+            print(f"[trlx_tpu.serve] drained "
+                  f"({'clean' if self._drain_clean else 'deadline hit'})",
+                  file=sys.stderr, flush=True)
+            self._drain_done.set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Blocking drain for in-process callers (tests): begin + wait.
+        Returns True when everything in flight finished cleanly."""
+        self.begin_drain()
+        budget = timeout if timeout is not None \
+            else self.engine.serve.drain_timeout + 30.0
+        self._drain_done.wait(timeout=budget)
+        return self._drain_clean
+
+    # -- live checkpoint hot-swap ----------------------------------------- #
+
+    def reload(self, checkpoint: Optional[str] = None) -> dict:
+        """Hot-swap the serving weights from ``checkpoint`` (a concrete
+        checkpoint directory, or a run directory whose ``LATEST`` is
+        re-resolved; default: the run directory this engine was built
+        from). Delegates the swap protocol — step-boundary install,
+        smoke probe, rollback — to the scheduler; raises
+        FileNotFoundError/ValueError for unusable paths (HTTP 400)."""
+        if checkpoint is None:
+            if self.engine.checkpoint_path is None:
+                raise ValueError(
+                    "no default checkpoint to reload: the engine was not "
+                    "built from one — name one in the request body "
+                    '({"checkpoint": "..."})'
+                )
+            checkpoint = os.path.dirname(self.engine.checkpoint_path)
+        params, resolved = self.engine.load_params(checkpoint)
+        result = self.batcher.request_swap(params, label=resolved)
+        result["checkpoint"] = resolved
+        if result.get("reloaded"):
+            print(f"[trlx_tpu.serve] hot-swapped to {resolved} "
+                  f"(model_version {result['model_version']})",
+                  file=sys.stderr, flush=True)
+        else:
+            print(f"[trlx_tpu.serve] reload REJECTED ({resolved}): "
+                  f"{result.get('reason')}", file=sys.stderr, flush=True)
+        return result
+
+    def _watch_loop(self) -> None:
+        """``serve.watch_checkpoints`` poller: re-resolve the run
+        directory's ``LATEST`` every interval and hot-swap when it moves.
+        A checkpoint that fails its probe is remembered and not retried
+        until ``LATEST`` moves again (no hot-loop on a bad save)."""
+        from trlx_tpu.utils.checkpoint import find_latest_checkpoint
+
+        interval = float(self.engine.serve.watch_checkpoints)
+        run_dir = os.path.dirname(self.engine.checkpoint_path)
+        while not self._watch_stop.wait(interval):
+            if self.draining:
+                return
+            try:
+                latest = find_latest_checkpoint(run_dir)
+            except OSError as e:
+                print(f"[trlx_tpu.serve] checkpoint watch: {e!r}",
+                      file=sys.stderr, flush=True)
+                continue
+            if latest is None or latest == self.engine.checkpoint_path \
+                    or latest == self._watch_last_tried:
+                continue
+            self._watch_last_tried = latest
+            try:
+                self.reload(latest)
+            except Exception as e:
+                telemetry.inc("serve/reload_failures")
+                print(f"[trlx_tpu.serve] watched reload of {latest} "
+                      f"failed: {e!r}", file=sys.stderr, flush=True)
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -317,6 +521,9 @@ class InferenceServer:
                 )
                 telemetry.set_gauge("serve/prefix_hit_rate", 0.0)
                 telemetry.set_gauge("serve/pages_per_request_p95", 0.0)
+        telemetry.set_gauge(
+            "serve/model_version", self.engine.model_version
+        )
         if warmup and not self.warmed:
             if self.engine.serve.scheduler == "slots":
                 latencies = self.batcher.warmup()
@@ -326,6 +533,19 @@ class InferenceServer:
                 print(f"[trlx_tpu.serve] warmed {name}: {secs:.3f}s "
                       f"first call (compile)", file=sys.stderr, flush=True)
         self.batcher.start()
+        if self.engine.serve.watch_checkpoints > 0 \
+                and self._watch_thread is None:
+            if self.engine.checkpoint_path is None:
+                print("[trlx_tpu.serve] serve.watch_checkpoints set but "
+                      "the engine was not built from a checkpoint; "
+                      "nothing to watch", file=sys.stderr, flush=True)
+            else:
+                self._watch_stop.clear()
+                self._watch_thread = threading.Thread(
+                    target=self._watch_loop, name="trlx-serve-watch",
+                    daemon=True,
+                )
+                self._watch_thread.start()
         handler = type("Handler", (_Handler,), {"server_ref": self})
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]  # resolve port=0
@@ -340,22 +560,50 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._http_thread is not None:
-            self._http_thread.join(timeout=5.0)
-            self._http_thread = None
+        # idempotent and thread-safe: the drain thread's _do_drain and the
+        # owner's own stop() may race here
+        self._watch_stop.set()
+        with self._stop_lock:
+            watch, self._watch_thread = self._watch_thread, None
+            httpd, self._httpd = self._httpd, None
+            http_thread, self._http_thread = self._http_thread, None
+        if watch is not None:
+            watch.join(timeout=5.0)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if http_thread is not None:
+            http_thread.join(timeout=5.0)
         self.batcher.stop()
 
+    def _on_sigterm(self, signum, frame) -> None:
+        # runs between bytecodes on the main thread: must return fast —
+        # the actual drain happens on the background drain thread
+        print("[trlx_tpu.serve] SIGTERM: beginning graceful drain",
+              file=sys.stderr, flush=True)
+        self.begin_drain()
+
     def serve_forever(self) -> None:
-        """Block the calling thread until interrupted (the CLI's tail)."""
+        """Block the calling thread until the server drains (the CLI's
+        tail). SIGTERM and Ctrl-C both begin a graceful drain — finish
+        in-flight work within ``serve.drain_timeout``, flush telemetry +
+        flight recorder — and this returns normally, so the process
+        exits 0 and the orchestrator sees a clean rotation."""
         try:
-            while True:
-                threading.Event().wait(3600.0)
-        except KeyboardInterrupt:
-            print("[trlx_tpu.serve] interrupted; shutting down",
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError as e:
+            # not the main thread: Ctrl-C/begin_drain() still work
+            print(f"[trlx_tpu.serve] SIGTERM handler not installed: {e}",
                   file=sys.stderr, flush=True)
+        try:
+            while not self._drain_done.wait(timeout=1.0):
+                continue
+        except KeyboardInterrupt:
+            print("[trlx_tpu.serve] interrupted; beginning graceful drain",
+                  file=sys.stderr, flush=True)
+            self.begin_drain()
+            self._drain_done.wait(
+                timeout=self.engine.serve.drain_timeout + 30.0
+            )
         finally:
             self.stop()

@@ -41,8 +41,22 @@ Containment mirrors the static path: the worker thread enters the serve
 supervisor; admission runs as the ``serve_admit`` phase (chaos seam
 ``serve_admit`` — a wedged admission is a stall the watchdog can
 attribute, not silence) and each decode step as ``serve_decode`` with a
-heartbeat per step. A poisoned step fails the live requests, resets the
-lanes, and keeps serving; a poisoned admission fails only its batch.
+heartbeat per step. Crash-only recovery (docs "Fault tolerance",
+"serving lifecycle"): the unit of failure is the STEP, not the request.
+A poisoned step (or admission) dumps the flight recorder, resets the
+lanes + prefix cache, and RE-QUEUES every in-flight request with its
+committed tokens journaled host-side — re-admission prefills
+``prompt + committed`` (paged: the committed prefix maps copy-free
+through the radix cache) and resumes decode from the last committed
+token, bit-identical under greedy decode. The per-request replay budget
+is ``serve.max_replays`` (exceed -> ReplayExhausted, HTTP 503). The
+``serve_replay`` chaos seam fires at recovery entry; a fault THERE is a
+double fault and falls back to failing the batch (the PR-5 behavior).
+:meth:`SlotScheduler.drain` runs the graceful half (finish in-flight
+within ``serve.drain_timeout``, admission -> Draining/429), and
+:meth:`SlotScheduler.request_swap` hot-swaps checkpoints at a step
+boundary with a smoke probe + rollback — both worker-applied, zero
+recompiles (seam ``serve_reload``).
 
 Metrics (trlx_tpu.telemetry): ``serve/admissions`` / ``serve/evictions``
 / ``serve/preempted_steps`` counters, ``serve/slot_occupancy`` gauge,
@@ -64,7 +78,15 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from trlx_tpu import supervisor, telemetry
-from trlx_tpu.serve.batcher import QueueFull, Request
+from trlx_tpu.serve.batcher import (
+    Draining,
+    DrainTimeout,
+    QueueFull,
+    ReplayExhausted,
+    Request,
+    _validate_deadline,
+    shed_expired,
+)
 from trlx_tpu.serve.trace import FlightRecorder, RequestTrace
 from trlx_tpu.supervisor import chaos, monotonic
 
@@ -384,6 +406,14 @@ class SlotScheduler:
         # reset by _run after each step's record lands in the ring
         self._fr_admitted = 0
         self._fr_evicted = 0
+        # -- crash-only lifecycle state (docs "Fault tolerance") -------- #
+        self._draining = False
+        self._drain_deadline = 0.0
+        self._drained = threading.Event()
+        #: worker-applied hot-swap: {"params", "label", "done", "result"}
+        self._pending_swap: Optional[Dict] = None
+        self._last_step_ms = 0.0
+        self._replayed_requests = 0  # lifetime; /debug/state + bench
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -432,13 +462,23 @@ class SlotScheduler:
     def submit(self, tokens: List[int],
                max_new_tokens: Optional[int] = None,
                seed: Optional[int] = None,
-               trace: Optional[RequestTrace] = None) -> Request:
+               trace: Optional[RequestTrace] = None,
+               deadline_ms: Optional[float] = None,
+               priority: int = 0) -> Request:
         """Enqueue one request; same validation/admission contract as the
         static micro-batcher (ValueError when no bucket fits, QueueFull
-        past ``max_queue``). ``seed`` is accepted for surface parity but
-        the sampling stream is per-STEP here (a request's draws depend on
-        which steps it rides), so only greedy decode is exactly
-        reproducible."""
+        past ``max_queue``, Draining during a graceful drain). ``seed``
+        is accepted for surface parity but the sampling stream is
+        per-STEP here (a request's draws depend on which steps it rides),
+        so only greedy decode is exactly reproducible.
+
+        Overload control: ``deadline_ms`` bounds queueing — a request
+        still queued past it is shed (DeadlineExceeded, 503) at the next
+        admission scan instead of decoded uselessly; higher ``priority``
+        admits first (ties FIFO). When the engine is degraded (slot/page
+        starvation, or a step over ``serve.degrade_step_ms``) the
+        effective queue bound halves — adaptive admission sheds load at
+        the door while the backlog is least likely to drain."""
         if not tokens:
             raise ValueError("empty prompt: at least one token is required")
         if max_new_tokens is None:
@@ -446,6 +486,7 @@ class SlotScheduler:
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
+        deadline_s = _validate_deadline(deadline_ms)
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
         if self.cache is not None:
             need = self.engine.request_page_need(
@@ -461,12 +502,26 @@ class SlotScheduler:
         if trace is None and self._tracing:
             trace = RequestTrace()
         req = Request(list(tokens), max_new_tokens, shape, seed=seed,
-                      trace=trace)
+                      trace=trace, deadline_s=deadline_s,
+                      priority=priority)
         with self._cond:
-            if len(self._queue) >= self.max_queue:
+            if self._draining:
                 telemetry.inc("serve/rejected")
+                raise Draining(
+                    "server is draining: admission is closed while "
+                    "in-flight requests finish (serve.drain_timeout); "
+                    "retry against another replica"
+                )
+            cap = self.max_queue
+            if self._degraded():
+                cap = max(1, self.max_queue // 2)
+            telemetry.set_gauge("serve/admission_limit", cap)
+            if len(self._queue) >= cap:
+                telemetry.inc("serve/rejected")
+                detail = " (halved: engine degraded)" \
+                    if cap < self.max_queue else ""
                 raise QueueFull(
-                    f"serve queue is full ({self.max_queue} pending); "
+                    f"serve queue is full ({cap} pending{detail}); "
                     f"retry with backoff (serve.max_queue bounds queueing "
                     f"delay — raise it to trade latency for acceptance)"
                 )
@@ -476,6 +531,35 @@ class SlotScheduler:
             self._cond.notify_all()
         return req
 
+    def _degraded(self) -> bool:
+        """Adaptive-admission signal: requests starved for slots/pages,
+        the page pool pinned empty, or the last step over the
+        ``serve.degrade_step_ms`` budget."""
+        if self._starved:
+            return True
+        if self.cache is not None and self.cache.free_pages() == 0:
+            return True
+        limit_ms = float(getattr(self.engine.serve, "degrade_step_ms", 0.0))
+        return bool(limit_ms > 0 and self._last_step_ms > limit_ms)
+
+    def step_p50_s(self) -> float:
+        """Recent decode-step p50 (the ``time/serve/slot_step``
+        histogram's steady-state window) — the pacing term in
+        ``Retry-After``. Falls back to 50ms before any steps land."""
+        tel = telemetry.current()
+        if tel is not None:
+            hist = tel.registry.hists.get(f"time/{self.runtime.STEP_SPAN}")
+            if hist is not None and hist.count:
+                return max(hist.quantile(0.5), 1e-4)
+        return 0.05
+
+    def retry_after_s(self) -> int:
+        """The 429 ``Retry-After`` hint: queue depth x recent step p50 —
+        roughly how long the current backlog takes to start draining.
+        Never below 1s (clients must not hot-loop on a full queue)."""
+        estimate = len(self._queue) * self.step_p50_s()
+        return max(1, int(-(-estimate // 1)))
+
     # -- worker ----------------------------------------------------------- #
 
     def _occupancy(self) -> float:
@@ -483,22 +567,32 @@ class SlotScheduler:
 
     def _admit(self) -> None:
         """Move queued requests into free slots, one prompt-class bucket
-        at a time (FIFO head's class first). Sets ``_starved`` when
-        requests are left waiting with no free slot (or, paged, no
-        obtainable page) — the next step then counts as
-        ``serve/preempted_steps``."""
+        at a time (highest-priority head's class first, ties FIFO by
+        ``seq``). Queued requests past their ``deadline_ms`` are shed
+        here (DeadlineExceeded, ``serve/shed_expired``) before any slot
+        is spent on them. Sets ``_starved`` when requests are left
+        waiting with no free slot (or, paged, no obtainable page) — the
+        next step then counts as ``serve/preempted_steps``."""
+        by_prio = lambda r: (-r.priority, r.seq)  # noqa: E731
         while True:
             with self._cond:
+                if self._queue:
+                    survivors = shed_expired(list(self._queue), monotonic())
+                    if len(survivors) != len(self._queue):
+                        self._queue = deque(survivors)
+                        telemetry.set_gauge(
+                            "serve/queue_depth", len(self._queue)
+                        )
                 self._starved = bool(self._queue) and not self._free
                 if not self._queue or not self._free:
                     return
-                P = self._queue[0].shape[0]
+                P = min(self._queue, key=by_prio).shape[0]
                 extents = self.engine.prefill_batch_sizes(P)
-                take = min(
-                    sum(1 for r in self._queue if r.shape[0] == P),
-                    len(self._free), extents[-1],
+                same = sorted(
+                    (r for r in self._queue if r.shape[0] == P), key=by_prio
                 )
-                batch = [r for r in self._queue if r.shape[0] == P][:take]
+                take = min(len(same), len(self._free), extents[-1])
+                batch = same[:take]
                 for r in batch:
                     self._queue.remove(r)
                 telemetry.set_gauge("serve/queue_depth", len(self._queue))
@@ -508,18 +602,16 @@ class SlotScheduler:
                     chaos.maybe_inject("serve_admit")
                     admitted_all = self._prefill_batch(batch, P, extents)
                 except Exception as e:
-                    # a poisoned admission fails ITS requests (paged:
-                    # page-starved ones were already re-queued and
-                    # removed from `batch`, so they are NOT failed); the
+                    # a poisoned admission RE-QUEUES its requests for
+                    # replay (bounded by serve.max_replays) instead of
+                    # failing them (paged: page-starved ones were
+                    # already re-queued and removed from `batch`); the
                     # pool lanes were only touched if the device call
                     # ran, and dropped-sentinel scatters cannot corrupt
                     # live slots
                     if self.flight is not None:
                         self.flight.dump(f"admission failure: {e!r}")
-                    telemetry.inc("serve/request_errors", len(batch))
-                    for r in batch:
-                        r.error = e
-                        r.done.set()
+                    self._requeue_for_replay(batch, e)
                 supervisor.beat()
             if not admitted_all:
                 # page pool exhausted mid-batch: requests stay QUEUED
@@ -537,16 +629,22 @@ class SlotScheduler:
         slots = [self._free.pop() for _ in batch]
         sentinel = self.runtime.num_slots
         slot_ids = slots + [sentinel] * (Bp - len(batch))
-        rows = [r.tokens for r in batch]
+        # replayed requests prefill prompt + journaled committed tokens
+        # and decode only the REMAINING budget — greedy decode is Markov
+        # on the token prefix, so the resumed stream is bit-identical
+        rows = [r.tokens + r.committed for r in batch]
         tokens, mask = self.engine.pad_batch(rows, (Bp, P, 0))
-        max_new = [r.max_new_tokens for r in batch]
+        max_new = [r.remaining_new_tokens() for r in batch]
         max_new += [1] * (Bp - len(batch))
         admit_at = monotonic()
+        version = self.engine.model_version
         for r in batch:
+            r.model_version = version
             if r.trace is not None:
                 r.trace.admitted = admit_at
                 r.trace.bucket = (Bp, P)
                 r.trace.prefill_start = admit_at
+                r.trace.model_version = version
         try:
             self.runtime.prefill((Bp, P), tokens, mask, slot_ids, max_new)
         except Exception:
@@ -556,7 +654,9 @@ class SlotScheduler:
         for r, s in zip(batch, slots):
             if r.trace is not None:
                 r.trace.prefill_end = prefill_end
-            self._live[s] = _LiveSlot(r)
+            live = _LiveSlot(r)
+            live.tokens = list(r.committed)
+            self._live[s] = live
             self.events.append(("admit", s, r))
         self._fr_admitted += len(batch)
         telemetry.inc("serve/admissions", len(batch))
@@ -575,10 +675,14 @@ class SlotScheduler:
         plans = []  # (request, toks, matched, pages, committed)
         deferred: List[Request] = []
         for i, r in enumerate(batch):
-            toks = r.tokens[-P:]
+            # replay: the journaled committed tokens extend the prompt —
+            # the already-decoded prefix radix-matches (its pages are
+            # still cached unless the poisoned reset wiped them) and only
+            # the unmatched suffix prefills
+            toks = (r.tokens + r.committed)[-P:]
             matched = self.cache.match(toks)
             need = self.engine.request_page_need(
-                len(toks), r.max_new_tokens
+                len(toks), r.remaining_new_tokens()
             ) - len(matched)
             fresh = self.cache.alloc(need)
             if fresh is None:
@@ -615,6 +719,7 @@ class SlotScheduler:
         max_new = np.ones((Bp,), np.int32)
         slot_ids = np.full((Bp,), self.runtime.num_slots, np.int32)
         admit_at = monotonic()
+        version = self.engine.model_version
         for j, ((r, toks, matched, pages, _), s) in enumerate(
             zip(plans, slots)
         ):
@@ -624,8 +729,9 @@ class SlotScheduler:
             mask[j, :len(suf)] = 1
             page_tables[j, :len(pages)] = pages
             starts[j] = start
-            max_new[j] = r.max_new_tokens
+            max_new[j] = r.remaining_new_tokens()
             slot_ids[j] = s
+            r.model_version = version
             if r.trace is not None:
                 r.trace.admitted = admit_at
                 r.trace.bucket = (Bp, P)
@@ -633,6 +739,7 @@ class SlotScheduler:
                 r.trace.pages_reserved = len(pages)
                 r.trace.prefix_blocks_hit = len(matched)
                 r.trace.suffix_len = len(suf)
+                r.trace.model_version = version
         try:
             self.runtime.prefill(
                 (Bp, P), tokens, mask, slot_ids, max_new,
@@ -651,7 +758,9 @@ class SlotScheduler:
         for (r, toks, matched, pages, committed), s in zip(plans, slots):
             if r.trace is not None:
                 r.trace.prefill_end = prefill_end
-            self._live[s] = _LiveSlot(r, pages=pages, committed=committed)
+            live = _LiveSlot(r, pages=pages, committed=committed)
+            live.tokens = list(r.committed)
+            self._live[s] = live
             self.events.append(("admit", s, r))
             saved += len(matched) * ps
             self._prompt_tokens_total += len(toks)
@@ -751,24 +860,12 @@ class SlotScheduler:
                     )
         telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
 
-    def _fail_live(self, error: BaseException) -> None:
-        """Poisoned-step containment: fail every in-flight request, free
-        all slots, reset the device lanes, keep the loop serving. The
-        flight recorder dumps FIRST — the engine state that led into the
-        poisoned step is exactly what the ring holds."""
-        if self.flight is not None:
-            self.flight.dump(f"poisoned step: {error!r}")
-        live = list(self._live.values())
-        self._live.clear()
-        self._free = list(range(self.runtime.num_slots))
-        telemetry.inc("serve/request_errors", len(live))
-        # contain FIRST, signal last: a waiter released by done.set()
-        # must observe the post-reset pool/cache, not a torn intermediate
-        self.runtime.reset_lanes()
+    def _reset_cache(self) -> None:
+        """Fresh allocator + radix tree. The lanes are gone whenever this
+        runs, so every page mapping (and every cached prefix whose
+        content can no longer be trusted — poisoned step, or KV computed
+        under pre-swap weights) resets with them."""
         if self.cache is not None:
-            # the lanes are gone, so every page mapping (and every cached
-            # prefix whose content can no longer be trusted after a
-            # poisoned step) resets with them
             from trlx_tpu.serve.paged import RadixCache
 
             self.cache = RadixCache(
@@ -777,10 +874,298 @@ class SlotScheduler:
             telemetry.set_gauge(
                 "serve/pages_free", self.cache.free_pages()
             )
+
+    def _fail_live(self, error: BaseException) -> None:
+        """Last-resort containment (double fault, or replay disabled):
+        fail every in-flight request, free all slots, reset the device
+        lanes, keep the loop serving."""
+        live = list(self._live.values())
+        self._live.clear()
+        self._free = list(range(self.runtime.num_slots))
+        telemetry.inc("serve/request_errors", len(live))
+        # contain FIRST, signal last: a waiter released by done.set()
+        # must observe the post-reset pool/cache, not a torn intermediate
+        self.runtime.reset_lanes()
+        self._reset_cache()
         for s in live:
             s.request.error = error
             s.request.done.set()
         telemetry.set_gauge("serve/slot_occupancy", 0.0)
+
+    def _requeue_for_replay(self, requests: List[Request],
+                            error: BaseException) -> None:
+        """Journal-and-requeue: each request goes back to the queue head
+        (original admission order) carrying its committed tokens, unless
+        its ``serve.max_replays`` budget is spent or its grown effective
+        prompt no longer fits the bucket lattice — those complete with
+        ReplayExhausted (HTTP 503) and a reason."""
+        max_replays = int(getattr(self.engine.serve, "max_replays", 2))
+        survivors = []
+        for req in requests:
+            req.replays += 1
+            if req.trace is not None:
+                req.trace.replays = req.replays
+                req.trace.queue_reentries += 1
+            if req.replays > max_replays:
+                telemetry.inc("serve/request_errors")
+                req.error = ReplayExhausted(
+                    f"request hit {max_replays} engine faults "
+                    f"(serve.max_replays) and will not be replayed "
+                    f"again; last fault: {error!r}"
+                )
+                req.done.set()
+                continue
+            try:
+                # the committed prefix is part of the replay prompt, so
+                # the admission bucket can grow a class — or grow PAST
+                # the lattice, which ends the request with a reason
+                # instead of a crash
+                req.shape = self.engine.pick_shape(
+                    len(req.tokens) + len(req.committed),
+                    req.remaining_new_tokens(),
+                )
+            except ValueError as e:
+                telemetry.inc("serve/request_errors")
+                req.error = ReplayExhausted(
+                    f"cannot replay: prompt + {len(req.committed)} "
+                    f"committed tokens no longer fit the bucket "
+                    f"lattice ({e})"
+                )
+                req.done.set()
+                continue
+            survivors.append(req)
+        if survivors:
+            self._replayed_requests += len(survivors)
+            telemetry.inc("serve/replays", len(survivors))
+            with self._cond:
+                for req in sorted(
+                    survivors, key=lambda r: r.seq, reverse=True
+                ):
+                    self._queue.appendleft(req)
+                telemetry.set_gauge("serve/queue_depth", len(self._queue))
+                self._cond.notify_all()
+
+    def _recover_step(self, error: BaseException) -> None:
+        """Poisoned-step recovery: dump the flight recorder (the engine
+        state that led INTO the poisoned step is exactly what the ring
+        holds), reset lanes + cache, then re-queue — not fail — every
+        in-flight request with its committed tokens journaled. The
+        ``serve_replay`` chaos seam fires before any mutation; a fault
+        there (or during the reset itself) is a double fault and falls
+        back to :meth:`_fail_live`."""
+        if self.flight is not None:
+            self.flight.dump(f"poisoned step: {error!r}")
+        try:
+            chaos.maybe_inject("serve_replay")
+        except Exception as twice:
+            self._fail_live(twice)
+            return
+        live = list(self._live.values())
+        self._live.clear()
+        self._free = list(range(self.runtime.num_slots))
+        try:
+            self.runtime.reset_lanes()
+            self._reset_cache()
+        except Exception as twice:
+            telemetry.inc("serve/request_errors", len(live))
+            for s in live:
+                s.request.error = twice
+                s.request.done.set()
+            telemetry.set_gauge("serve/slot_occupancy", 0.0)
+            return
+        for s in live:
+            # journal BEFORE requeue: live.tokens is committed-so-far
+            # (prior journal + tokens harvested since re-admission)
+            s.request.committed = list(s.tokens)
+        self._requeue_for_replay([s.request for s in live], error)
+        telemetry.set_gauge("serve/slot_occupancy", 0.0)
+
+    # -- graceful drain ---------------------------------------------------- #
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: flip admission to Draining (HTTP 429), keep
+        admitting ALREADY-QUEUED requests and stepping until everything
+        in flight finishes, then return. Requests still unfinished at
+        the deadline (default ``serve.drain_timeout``) complete with
+        DrainTimeout (HTTP 503) — shed with a reason, never dropped.
+        Dumps the flight recorder on entry so a killed replica's
+        post-mortem has engine state. Returns True when the drain was
+        clean (nothing shed). Idempotent; the worker is stopped on the
+        way out."""
+        if timeout is None:
+            timeout = float(getattr(self.engine.serve, "drain_timeout",
+                                    30.0))
+        with self._cond:
+            already = self._draining
+            self._draining = True
+            self._drain_deadline = monotonic() + float(timeout)
+            self._cond.notify_all()
+        if not already:
+            telemetry.inc("serve/drains")
+            if self.flight is not None:
+                self.flight.dump("drain")
+        if self._thread is None:
+            # never started: nothing in flight can ever finish
+            self._drain_expire()
+        else:
+            self._drained.wait(timeout=float(timeout) + 10.0)
+        clean = not self._queue and not self._live
+        self.stop()
+        return clean
+
+    def _drain_expire(self) -> None:
+        """Drain deadline passed: complete everything still in flight
+        with DrainTimeout (worker thread, or inline when the worker was
+        never started)."""
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+            telemetry.set_gauge("serve/queue_depth", 0)
+        live = list(self._live.values())
+        self._live.clear()
+        self._free = list(range(self.runtime.num_slots))
+        victims = pending + [s.request for s in live]
+        if victims:
+            telemetry.inc("serve/request_errors", len(victims))
+        if live:
+            self.runtime.reset_lanes()
+            self._reset_cache()
+        for req in victims:
+            req.error = DrainTimeout(
+                "server drain deadline (serve.drain_timeout) passed "
+                "with the request still in flight; retry against "
+                "another replica"
+            )
+            req.done.set()
+        telemetry.set_gauge("serve/slot_occupancy", 0.0)
+        self._drained.set()
+
+    # -- live checkpoint hot-swap ------------------------------------------ #
+
+    def request_swap(self, params, label: str = "") -> Dict:
+        """Hot-swap the serving weights to ``params`` (a full TRAINING
+        param tree; the engine strips it to decode views). The swap is
+        worker-applied at a step boundary: admission pauses (submit
+        still accepts — the endpoint never refuses connections), live
+        slots finish on their admitted version, then the worker resets
+        KV state, installs the candidate into same-sharding buffers,
+        smoke-probes one bucket for non-finite logits, and either
+        commits (``serve/model_version`` bumps) or rolls back to the old
+        views. Zero recompiles either way — the compiled executables
+        take the weights as ARGUMENTS. Blocks until applied; returns
+        ``{"reloaded", "model_version", ...}``."""
+        box = {
+            "params": params, "label": label,
+            "done": threading.Event(), "result": None,
+        }
+        with self._cond:
+            if self._pending_swap is not None:
+                return {
+                    "reloaded": False,
+                    "model_version": self.engine.model_version,
+                    "reason": "another reload is already in progress",
+                }
+            self._pending_swap = box
+            self._cond.notify_all()
+        if self._thread is None:
+            self._apply_pending_swap()  # idle engine: swap inline
+        else:
+            box["done"].wait(
+                timeout=float(self.engine.serve.request_timeout) + 30.0
+            )
+        if box["result"] is None:
+            return {
+                "reloaded": False,
+                "model_version": self.engine.model_version,
+                "reason": "reload timed out waiting for a step boundary",
+            }
+        return box["result"]
+
+    def _apply_pending_swap(self) -> None:
+        """Worker-side half of :meth:`request_swap`; runs only with
+        ``_live`` empty (the step boundary). Probe failure — shape/dtype
+        drift, non-finite logits, a ``serve_reload`` chaos fault —
+        restores the old view references and the engine keeps serving
+        version N."""
+        box = self._pending_swap
+        if box is None:
+            return
+        e = self.engine
+        old_version = e.model_version
+        old_views = (e.blocks, e.embed, e.ln_f)
+        try:
+            chaos.maybe_inject("serve_reload")
+            views = e.strip_for_serve(box["params"])
+            e.validate_swap(views)
+            # KV pages + cached prefixes were computed under the OLD
+            # weights — wrong under the new ones. Lanes are already
+            # empty (step-boundary swap); reset the cache with them.
+            self.runtime.reset_lanes()
+            self._reset_cache()
+            e.install_views(views)
+            self._probe_swap()
+        except Exception as err:
+            e.install_views(old_views)  # rollback: old refs still alive
+            self.runtime.reset_lanes()
+            self._reset_cache()
+            telemetry.inc("serve/reload_failures")
+            box["result"] = {
+                "reloaded": False, "model_version": old_version,
+                "reason": f"{type(err).__name__}: {err}",
+            }
+        else:
+            version = e.commit_version(box["label"] or None)
+            telemetry.inc("serve/reloads")
+            box["result"] = {
+                "reloaded": True, "model_version": version,
+                "previous_version": old_version,
+            }
+        self._pending_swap = None
+        box["done"].set()
+
+    def _probe_swap(self) -> None:
+        """One-bucket smoke probe through the ALREADY-COMPILED smallest
+        prefill executable (zero recompiles): prefill a dummy token into
+        real slot 0 and require finite logits under the candidate
+        weights. The lanes are reset afterwards — the probe leaves no
+        live lane (or page mapping) behind."""
+        rt = self.runtime
+        P, extents = next(iter(self.engine.prompt_classes()))
+        Bp = extents[0]
+        pad = self.engine.pad_token_id
+        tokens = np.full((Bp, P), pad, np.int32)
+        mask = np.zeros((Bp, P), np.int32)
+        paged = rt.kv_layout == "paged"
+        if paged:
+            tokens[:, 0] = 0
+            mask[:, 0] = 1
+        else:
+            tokens[:, -1] = 0
+            mask[:, -1] = 1
+        slot_ids = np.full((Bp,), rt.num_slots, np.int32)
+        slot_ids[0] = 0  # ONE real row — the probe reads its logits
+        page_tables = None
+        start = None
+        if paged:
+            page_tables = np.full(
+                (Bp, rt.max_pages), rt.num_pages, np.int32
+            )
+            need = self.engine.request_page_need(1, 1)
+            # the cache was reset just above: pages 0..need-1 are free
+            # and unmapped, and the post-probe reset unmaps them again
+            page_tables[0, :need] = np.arange(need, dtype=np.int32)
+            start = np.zeros((Bp,), np.int32)
+        rt.prefill(
+            (Bp, P), tokens, mask, slot_ids, np.ones((Bp,), np.int32),
+            page_tables=page_tables, start=start,
+        )
+        logits = np.asarray(rt.state.logits[0])
+        rt.reset_lanes()
+        if not np.all(np.isfinite(logits)):
+            raise ValueError(
+                "smoke probe produced non-finite logits under the "
+                "candidate checkpoint; rolling back"
+            )
 
     def _record_step(self, start: float, end: float) -> None:
         """One compact flight-recorder record per engine step; the
@@ -833,6 +1218,11 @@ class SlotScheduler:
             "queue_depth": len(self._queue),
             "free_slots": len(self._free),
             "starved": self._starved,
+            "degraded": self._degraded(),
+            "draining": self._draining,
+            "model_version": self.engine.model_version,
+            "replayed_requests": self._replayed_requests,
+            "last_step_ms": round(self._last_step_ms, 3),
             "slots": slots,
             "flight_recorder": (
                 self.flight.snapshot() if self.flight is not None else []
@@ -849,16 +1239,31 @@ class SlotScheduler:
             sup_cm = contextlib.nullcontext()
         with sup_cm:
             while not self._stop.is_set():
-                self._admit()
+                if self._pending_swap is not None:
+                    # admission pauses so _live can empty; queued +
+                    # in-flight requests finish on the ADMITTED version
+                    if not self._live:
+                        self._apply_pending_swap()
+                        continue
+                else:
+                    self._admit()
+                if self._draining:
+                    if not self._live and not self._queue:
+                        self._drained.set()
+                    elif monotonic() >= self._drain_deadline:
+                        self._drain_expire()
                 if not self._live:
                     with self._cond:
-                        if not self._queue and not self._stop.is_set():
+                        if not self._queue and not self._stop.is_set() \
+                                and self._pending_swap is None:
                             self._cond.wait(timeout=0.1)
                     continue
                 step_start = monotonic()
                 try:
                     self._step()
                 except Exception as e:
-                    self._fail_live(e)
+                    self._recover_step(e)
                 else:
-                    self._record_step(step_start, monotonic())
+                    end = monotonic()
+                    self._last_step_ms = (end - step_start) * 1000.0
+                    self._record_step(step_start, end)

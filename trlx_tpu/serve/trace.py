@@ -73,6 +73,7 @@ class RequestTrace:
         "harvested", "responded", "queue_reentries", "pages_reserved",
         "prefix_blocks_hit", "bucket", "suffix_len",
         "itl_count", "itl_total", "itl_min", "itl_max",
+        "replays", "model_version",
     )
 
     def __init__(self, trace_id: Optional[str] = None,
@@ -97,6 +98,12 @@ class RequestTrace:
         self.itl_total = 0.0
         self.itl_min = 0.0
         self.itl_max = 0.0
+        #: crash-only recovery: poisoned-step/admission re-queues this
+        #: request survived (trlx_tpu.serve.slots replay path)
+        self.replays = 0
+        #: the weight generation that ADMITTED this request (hot-swap
+        #: audit trail; engine.model_version at admission)
+        self.model_version = 0
 
     # -- lifecycle edges -------------------------------------------------- #
 
@@ -197,6 +204,10 @@ class RequestTrace:
             args["prefix_blocks_hit"] = self.prefix_blocks_hit
         if self.queue_reentries:
             args["queue_reentries"] = self.queue_reentries
+        if self.replays:
+            args["replays"] = self.replays
+        if self.model_version:
+            args["model_version"] = self.model_version
         tracer.add_span("serve/request", start, end, tid=self.tid,
                         args=args)
         if self.admitted:
@@ -236,6 +247,10 @@ class RequestTrace:
             "tokens": self.itl_count + 1 if self.first_token else 0,
             "queue_reentries": self.queue_reentries,
         }
+        if self.replays:
+            out["replays"] = self.replays
+        if self.model_version:
+            out["model_version"] = self.model_version
         if self.bucket is not None:
             out["bucket"] = list(self.bucket)
         if self.pages_reserved:

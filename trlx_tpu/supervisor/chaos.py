@@ -28,8 +28,14 @@ A schedule is a ``;``-separated list of rules::
   the top of the slot scheduler's PAGED admission, before the radix
   prefix walk / page allocation — a ``hang`` proves a wedged
   prefix-match is a watchdog-attributable ``serve_admit`` stall, not
-  silence), and ``serve_request`` (fired at request-handler entry — an
-  ``exc`` surfaces as the HTTP 500 error path).
+  silence), ``serve_request`` (fired at request-handler entry — an
+  ``exc`` surfaces as the HTTP 500 error path), ``serve_replay`` (fired
+  at poisoned-step RECOVERY entry, before any state mutation — an
+  ``exc`` there is the double-fault drill: replay is abandoned and the
+  in-flight batch fails like pre-replay containment), and
+  ``serve_reload`` (fired at checkpoint hot-swap application, before
+  the candidate weights install — an ``exc`` drives the
+  rollback-to-old-version path, ``serve/reload_failures``).
 - ``action``: ``hang`` (block ``param`` seconds, default 3600 — a
   bounded seam times out, the watchdog sees everything else), ``exc``
   (raise :class:`ChaosError`), ``slow`` (sleep ``param`` seconds, default

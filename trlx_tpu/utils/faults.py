@@ -30,8 +30,15 @@ sick run is visible in the metrics stream, not only in stdout archaeology
 (docs "Observability").
 """
 
+import random
 import time
 from typing import Any, Callable, Dict, Optional
+
+#: backoff jitter stream — intentionally UNSEEDED: after a shared-sink
+#: outage (reward service, tracker endpoint) every rank retries; a
+#: deterministic schedule would synchronize those retries into storms
+#: that re-down the sink, so each process draws its own delays
+_JITTER = random.Random()
 
 
 class DivergenceError(RuntimeError):
@@ -52,10 +59,20 @@ def retry_call(
     **kwargs: Any,
 ):
     """``fn(*args, **kwargs)`` with up to ``retries`` retries on exception,
-    exponential backoff between attempts (``backoff * 2**attempt`` seconds),
-    and the LAST exception re-raised when the budget is exhausted — a
-    persistently-broken seam must still fail loudly, just not on its first
-    hiccup. ``retries=0`` is a plain call.
+    decorrelated-jitter backoff between attempts, and the LAST exception
+    re-raised when the budget is exhausted — a persistently-broken seam
+    must still fail loudly, just not on its first hiccup. ``retries=0``
+    is a plain call.
+
+    The delay draws ``uniform(backoff, prev_delay * 3)``, capped at
+    ``backoff * 2**retries`` (the old fixed schedule's final rung), from
+    an unseeded per-process stream. Fixed exponential backoff
+    synchronizes retry storms: after a shared reward-service or tracker
+    outage, every rank sleeps the identical schedule and re-slams the
+    sink in lockstep at each rung. Decorrelated jitter (the AWS
+    "exponential backoff and jitter" result) spreads those retries while
+    keeping the same expected growth; ``backoff=0`` disables sleeping
+    entirely, exactly as before.
 
     ``timeout > 0`` runs each attempt through a bounded worker
     (trlx_tpu.supervisor.seams.bounded_call), so a HUNG seam — one that
@@ -79,6 +96,7 @@ def retry_call(
         return fn(*args, **kwargs)
 
     attempt = 0
+    prev_delay = backoff
     while True:
         try:
             if timeout and timeout > 0:
@@ -93,7 +111,14 @@ def retry_call(
                 telemetry.inc("fault/host_giveups")
                 raise
             telemetry.inc("fault/host_retries")
-            delay = backoff * (2 ** (attempt - 1))
+            if backoff > 0:
+                delay = min(
+                    _JITTER.uniform(backoff, prev_delay * 3.0),
+                    backoff * (2.0 ** retries),
+                )
+                prev_delay = delay
+            else:
+                delay = 0.0
             log(
                 f"[trlx_tpu] {label or getattr(fn, '__name__', 'call')} "
                 f"failed ({type(e).__name__}: {e}); retry "
