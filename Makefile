@@ -2,7 +2,7 @@
 # ruff covers formatting-adjacent lint + import order; the stdlib fallback
 # (tests/test_style.py) enforces the core rules where ruff isn't installed.
 
-.PHONY: style check test faults telemetry chaos serve serve-soak serve-chaos
+.PHONY: style check test faults telemetry chaos serve serve-mesh serve-soak serve-chaos
 
 check:
 	@command -v ruff >/dev/null 2>&1 \
@@ -74,6 +74,20 @@ serve:
 		tests/test_slots.py tests/test_paged.py \
 		tests/test_request_trace.py tests/test_lifecycle.py \
 		-q -m 'not slow'
+
+# sharded-serving rig (tests/test_serve_mesh.py): tp=2 and tp=2 x
+# fsdp=2 engines on CPU-simulated devices — greedy bit-parity vs the
+# single-device engine across page sizes, replay + hot-swap under the
+# mesh, zero recompiles, zero page leaks. Slow-marked (per-mesh bucket
+# compiles would blow the tier-1 walltime budget) so this target is the
+# way to run them; the multichip dryrun's serve leg is the fast canary.
+# The forced device count is set EXPLICITLY here so the target works
+# outside the pytest conftest (which forces the same 8 devices for
+# in-process tier-1 runs).
+serve-mesh:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -m pytest tests/test_serve_mesh.py -q -m mesh
 
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \

@@ -1056,6 +1056,15 @@ def bench_serving(n_requests=96, trace_seed=17):
     radix cache, and ``serve_chaos_vs_clean`` the tok/s the fault +
     swap window cost against the clean prefix leg.
 
+    Leg 4 — sharded leg (needs >= 2 devices, else skipped): the SAME
+    mixed trace replayed against a ``serve.mesh: {tp: 2}`` engine —
+    KV pages and attention head-sharded across two devices, the host
+    scheduler unchanged (docs "Sharded serving"). Reports
+    ``serve_tp_tokens_per_sec`` and ``serve_tp_scaling_eff`` (ratio vs
+    the single-device paged leg; ~1.0 on CPU-simulated devices where
+    "chips" share the same cores, > 1 where per-chip bandwidth is
+    real), plus TTFT/ITL p95 deltas against the paged leg.
+
     Every leg also reports the request-lifecycle SLO metrics
     (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
     ``serve_itl_p50/p95_ms``, and the paged leg runs an extra
@@ -1292,8 +1301,6 @@ def bench_serving(n_requests=96, trace_seed=17):
         f"{replay_saved} replay prefill tokens mapped through the "
         f"prefix cache, 0 lost")
 
-    jax.block_until_ready(engine.blocks)
-
     def slo_keys(stats, suffix=""):
         return {
             f"serve_ttft_p50_ms{suffix}": round(stats["ttft_p50"], 1),
@@ -1301,6 +1308,68 @@ def bench_serving(n_requests=96, trace_seed=17):
             f"serve_itl_p50_ms{suffix}": round(stats["itl_p50"], 2),
             f"serve_itl_p95_ms{suffix}": round(stats["itl_p95"], 2),
         }
+
+    # sharded leg: the mixed trace once more, against a tp=2 engine —
+    # same weights geometry, KV pool head-sharded across two devices,
+    # the SlotScheduler host loop untouched. Guarded on device count so
+    # the bench degrades gracefully on a single chip (the leg's keys
+    # are simply absent, never zero).
+    tp_keys = {}
+    if len(jax.devices()) >= 2:
+        tp_cfg = ServeConfig(
+            buckets=serve_cfg.buckets, max_wait_ms=8.0,
+            max_queue=max(256, n_requests), scheduler="slots", slots=16,
+            kv_layout="paged", page_size=16, mesh={"tp": 2},
+        )
+        telemetry.start()
+        tp_engine = InferenceEngine(config, serve=tp_cfg)
+        tp_sched = SlotScheduler(tp_engine)
+        tp_sched.warmup()
+        tp_sched.start()
+        try:
+            tp = replay(tp_sched)
+        finally:
+            tp_sched.stop()
+        tp_recompiles = int(
+            telemetry.current().registry.counters.get(
+                "compile/recompiles", 0.0
+            )
+        )
+        if tp_recompiles:
+            raise RuntimeError(
+                f"sharded leg recompiled {tp_recompiles}x in steady state"
+            )
+        tp_eff = tp["tok_s"] / max(paged["tok_s"], 1e-9)
+        log(f"serve[tp=2]:       {tp['tok_s']:,.1f} useful tok/s "
+            f"({tp_eff:.2f}x single-device paged), "
+            f"ttft p95 {tp['ttft_p95']:.0f} ms "
+            f"({tp['ttft_p95'] - paged['ttft_p95']:+.0f} ms), "
+            f"itl p95 {tp['itl_p95']:.1f} ms "
+            f"({tp['itl_p95'] - paged['itl_p95']:+.1f} ms), "
+            f"0 recompiles")
+        tp_keys = {
+            "serve_tp_tokens_per_sec": round(tp["tok_s"], 1),
+            "serve_tp_scaling_eff": round(tp_eff, 3),
+            "serve_tp_ttft_p95_delta_ms": round(
+                tp["ttft_p95"] - paged["ttft_p95"], 1
+            ),
+            "serve_tp_itl_p95_delta_ms": round(
+                tp["itl_p95"] - paged["itl_p95"], 2
+            ),
+            **slo_keys(tp, "_tp"),
+            "serve_tp_workload": (
+                f"the {n_requests}-request mixed burst replayed on a "
+                f"serve.mesh tp=2 engine (KV pages + attention "
+                f"head-sharded, host scheduler unchanged); efficiency "
+                f"is vs the single-device paged leg"
+            ),
+        }
+    else:
+        log("serve[tp=2]:       skipped (1 device; the sharded leg "
+            "needs >= 2 — real chips or "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+    jax.block_until_ready(engine.blocks)
 
     return {
         "serve_mixed_tokens_per_sec": round(paged["tok_s"], 1),
@@ -1366,6 +1435,8 @@ def bench_serving(n_requests=96, trace_seed=17):
             f"{n_requests}-request burst, 4 shared 48-token system "
             f"prompts + 2..8-token unique tails, paged page_size=16"
         ),
+        # sharded leg (absent on a single device)
+        **tp_keys,
     }
 
 

@@ -38,3 +38,19 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def serve_mesh_devices(devices):
+    """The mesh-serving rig: asserts the forced-host device pool covers
+    a tp=2 x fsdp=2 serve slice. In-process pytest runs always have 8
+    (the env block above forces them before jax initializes); standalone
+    runs go through `make serve-mesh`, which sets XLA_FLAGS explicitly.
+    Tests needing the rig take this fixture and carry @pytest.mark.mesh
+    so the target can select exactly them."""
+    if len(devices) < 4:
+        pytest.skip(
+            "mesh-serving tests need >= 4 devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    return devices

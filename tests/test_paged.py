@@ -549,7 +549,12 @@ def test_contiguous_fallback_still_serves(fresh_registry):
     registry = telemetry.current().registry
     s = SlotScheduler(engine)
     assert s.cache is None
-    assert s.pool_stats() == {"kv_layout": "contiguous", "slots": 4}
+    stats = s.pool_stats()
+    assert stats["kv_layout"] == "contiguous"
+    assert stats["slots"] == 4
+    # per-device footprint reports for both layouts; no paged keys here
+    assert stats["pool_gb_per_device"] > 0
+    assert "pages_total" not in stats
     s.warmup()
     s.start()
     try:

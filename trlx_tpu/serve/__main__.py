@@ -32,6 +32,22 @@ def parse_buckets(spec: str):
     return out
 
 
+def parse_mesh(spec: str):
+    """"tp=2,fsdp=2" -> {"tp": 2, "fsdp": 2} ("" -> single-device)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"mesh axis '{part}' is not AXIS=SIZE (e.g. tp=2,fsdp=2)"
+            )
+        axis, _, size = part.partition("=")
+        out[axis.strip()] = int(size)
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m trlx_tpu.serve",
@@ -94,6 +110,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="poll the run dir's LATEST every N seconds and "
                         "hot-swap new checkpoints live (0 = off; "
                         "POST /admin/reload always works)")
+    p.add_argument("--mesh", default=None,
+                   help="serve mesh as AXIS=SIZE pairs over tp/fsdp, "
+                        "e.g. 'tp=2,fsdp=2' — weights shard Megatron-"
+                        "style and KV pages shard on the head dim so a "
+                        "6B+ policy decodes from a slice (default: "
+                        "single device; '' forces single-device over a "
+                        "YAML serve.mesh)")
+    p.add_argument("--mesh-weights", choices=("fsdp", "replicated"),
+                   default=None,
+                   help="weight placement under --mesh: 'fsdp' shards "
+                        "the second matrix axis (capacity), "
+                        "'replicated' keeps weights whole per chip (no "
+                        "all-gathers on the decode path)")
     p.add_argument("--degrade-step-ms", type=float, default=None,
                    help="adaptive admission: halve the queue bound "
                         "while a decode step exceeds this (0 = off)")
@@ -117,6 +146,10 @@ def serve_config_from_args(args) -> ServeConfig:
     cfg = ServeConfig.from_dict(section)
     if args.buckets is not None:
         cfg.buckets = parse_buckets(args.buckets)
+    if args.mesh is not None:
+        cfg.mesh = parse_mesh(args.mesh) or None
+    if args.mesh_weights is not None:
+        cfg.mesh_weights = args.mesh_weights
     for flag, attr in (("host", "host"), ("port", "port"),
                        ("max_wait_ms", "max_wait_ms"),
                        ("max_queue", "max_queue"),

@@ -142,6 +142,18 @@ class ServeConfig:
         halves the effective queue bound (on top of the always-on
         degradation signals: slot/page starvation). 0 disables the
         step-time signal.
+    :param mesh: the serve mesh, ``{axis: size}`` over ``tp`` / ``fsdp``
+        (e.g. ``{tp: 4}`` for a v5e-4 slice; CLI ``--mesh tp=2,fsdp=2``).
+        Weights shard Megatron-style and KV pages shard on the head
+        dimension under ``tp`` (trlx_tpu.serve.layouts); the scheduler,
+        radix cache, allocator, and page tables stay host-side and
+        mesh-oblivious. None (default) serves from a single-device mesh —
+        the identical code path, today's behavior.
+    :param mesh_weights: weight placement on the second matrix axis:
+        ``"fsdp"`` (default) shards it for capacity — a 6B policy fits a
+        small slice; ``"replicated"`` keeps each weight whole per chip —
+        no all-gathers on the decode matvec path when HBM affords it
+        (docs/source/serving.rst has the sizing formula).
     """
 
     buckets: List[List[int]] = field(
@@ -166,6 +178,8 @@ class ServeConfig:
     drain_timeout: float = 30.0
     watch_checkpoints: float = 0.0
     degrade_step_ms: float = 0.0
+    mesh: Optional[Dict[str, int]] = None
+    mesh_weights: str = "fsdp"
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -281,6 +295,17 @@ class InferenceEngine:
                 f"serve.degrade_step_ms={self.serve.degrade_step_ms} "
                 f"must be >= 0 (0 = step-time degradation signal off)"
             )
+        if self.serve.mesh_weights not in ("fsdp", "replicated"):
+            raise ValueError(
+                f"serve.mesh_weights '{self.serve.mesh_weights}' is not "
+                f"one of: fsdp, replicated"
+            )
+        from trlx_tpu.serve.layouts import build_serve_mesh
+
+        #: the serve mesh every executable compiles against — a
+        #: single-device mesh when serve.mesh is unset (same code path,
+        #: today's placement), a {tp, fsdp} slice otherwise
+        self.mesh = build_serve_mesh(self.serve.mesh)
         self.buckets = _normalize_buckets(self.serve.buckets)
         self.tokenizer = load_tokenizer(config.model.tokenizer_path)
 
@@ -384,7 +409,6 @@ class InferenceEngine:
             META_NAME,
             find_latest_checkpoint,
             is_valid_checkpoint,
-            restore_components,
         )
 
         resolved = checkpoint if is_valid_checkpoint(checkpoint) \
@@ -409,10 +433,10 @@ class InferenceEngine:
             config = TRLConfig.load_yaml(config)
 
         engine = cls(config, serve=serve, init=False)
-        restored = restore_components(
-            {"params": engine._init_params()}, resolved
-        )
-        engine._install_params(restored["params"])
+        # streaming partial restore: decode subset only, per-leaf onto
+        # the live serve shardings (load_params docstring)
+        params, _ = engine.load_params(resolved)
+        engine._install_params(params)
         engine.checkpoint_path = resolved
         return engine
 
@@ -436,20 +460,48 @@ class InferenceEngine:
         once the caller's reference drops, the reference branch and the
         value head are garbage (opt_state was never restored at all), so
         steady-state memory holds one serving policy, not the training
-        triple."""
+        triple. The views land on the serve mesh under the decode
+        partition rules (trlx_tpu.serve.layouts) — on the default
+        single-device mesh that is plain device placement."""
         from trlx_tpu import telemetry
+        from trlx_tpu.serve import layouts
         from trlx_tpu.utils import tree_bytes
 
-        self.blocks = self.policy.all_blocks(params)
-        self.embed, self.ln_f = self.policy.head_params_for_decode(params)
+        blocks = self.policy.all_blocks(params)
+        embed, ln_f = self.policy.head_params_for_decode(params)
+        self.blocks, self.embed, self.ln_f = layouts.shard_decode_views(
+            self.mesh, (blocks, embed, ln_f),
+            weights=self.serve.mesh_weights,
+        )
         kept = tree_bytes((self.blocks, self.embed, self.ln_f))
         total = tree_bytes(params)
         telemetry.set_gauge("serve/model_gb", kept / 2**30)
         telemetry.set_gauge(
             "serve/stripped_gb", max(total - kept, 0) / 2**30
         )
+        telemetry.set_gauge("serve/mesh_devices", self.mesh.size)
+        telemetry.set_gauge(
+            "serve/params_gb_per_device",
+            layouts.tree_bytes_per_device(
+                (self.blocks, self.embed, self.ln_f)
+            ) / 2**30,
+        )
         self._decode_fns = {}  # shapes unchanged but weights swapped
         self.warmed = False
+
+    def mesh_info(self) -> Dict[str, Any]:
+        """The serve-mesh block /healthz and /debug/state report: axis
+        names/sizes, device count, weight placement, per-device params
+        GB (the thing capacity planning actually sizes against)."""
+        from trlx_tpu.serve import layouts
+
+        info = layouts.mesh_info(self.mesh, self.serve.mesh_weights)
+        if self.blocks is not None:
+            per_dev = layouts.tree_bytes_per_device(
+                (self.blocks, self.embed, self.ln_f)
+            )
+            info["params_gb_per_device"] = round(per_dev / 2**30, 6)
+        return info
 
     # -- live hot-swap (crash-only serving; docs "Fault tolerance") ------- #
 
@@ -526,16 +578,50 @@ class InferenceEngine:
         telemetry.set_gauge("serve/model_version", self.model_version)
         return self.model_version
 
+    def _serve_restore_template(self) -> Dict:
+        """ShapeDtypeStruct tree of the decode SUBSET of the ``params``
+        component: frozen trunk + trainable blocks/ln_f (+ lm_head when
+        untied). The reference branch and value head are absent, so a
+        partial restore never reads — let alone stages — them. Built
+        abstractly (``jax.eval_shape``): no throwaway init is ever
+        materialized."""
+        import jax
+
+        def abstract_init(rng):
+            if self._trunk is not None:
+                from trlx_tpu.models.hf_import import (
+                    hydra_params_from_trunk,
+                )
+
+                return hydra_params_from_trunk(
+                    self.policy, *self._trunk, rng
+                )
+            return self.policy.init(rng)
+
+        full = jax.eval_shape(abstract_init, jax.random.PRNGKey(0))
+        trainable = {
+            k: v for k, v in full["trainable"].items() if k != "v_head"
+        }
+        return {"frozen_base": full["frozen_base"],
+                "trainable": trainable}
+
     def load_params(self, checkpoint: str):
-        """Restore a full params tree for hot-swap: (params, resolved
-        checkpoint dir). ``checkpoint`` may be a committed checkpoint dir
-        or a run dir (the newest valid ``step_<N>`` is used). The
-        restore template is a throwaway re-init — transient host/device
-        memory during the reload, never retained."""
+        """Restore the decode subset of a checkpoint for install or
+        hot-swap: (partial params tree, resolved checkpoint dir).
+        ``checkpoint`` may be a committed checkpoint dir or a run dir
+        (the newest valid ``step_<N>`` is used).
+
+        Leaves stream from disk one at a time, each landing directly on
+        its live serve sharding (restore_component_sharded) — peak host
+        staging during a reload is ~one leaf, not one model, and the
+        training-only subtrees (reference branch, value head, opt state)
+        never leave disk. The returned tree is exactly what
+        :meth:`strip_for_serve` / :meth:`_install_params` read."""
+        from trlx_tpu.serve import layouts
         from trlx_tpu.utils.checkpoint import (
             find_latest_checkpoint,
             is_valid_checkpoint,
-            restore_components,
+            restore_component_sharded,
         )
 
         resolved = checkpoint if is_valid_checkpoint(checkpoint) \
@@ -546,10 +632,14 @@ class InferenceEngine:
                 f"from (expected a checkpoint dir or a run dir of "
                 f"'step_<N>' checkpoints)"
             )
-        restored = restore_components(
-            {"params": self._init_params()}, resolved
+        template = self._serve_restore_template()
+        shardings = layouts.decode_param_shardings(
+            self.mesh, template, weights=self.serve.mesh_weights
         )
-        return restored["params"], resolved
+        params = restore_component_sharded(
+            "params", template, shardings, resolved
+        )
+        return params, resolved
 
     # -- bucket lattice -------------------------------------------------- #
 

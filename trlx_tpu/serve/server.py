@@ -165,6 +165,7 @@ class _Handler(BaseHTTPRequestHandler):
             pool_stats = getattr(srv.batcher, "pool_stats", None)
             if pool_stats is not None:
                 body["kv"] = pool_stats()
+            body["mesh"] = srv.engine.mesh_info()
             self._json(200, body)
         elif self.path == "/metrics":
             accept = self.headers.get("Accept", "") or ""
@@ -524,6 +525,20 @@ class InferenceServer:
         telemetry.set_gauge(
             "serve/model_version", self.engine.model_version
         )
+        # serve-mesh capacity gauges, scraped from startup (also set at
+        # every weight install; re-asserted here so /metrics carries them
+        # even before the first install on deferred-init paths)
+        from trlx_tpu.serve import layouts
+
+        telemetry.set_gauge("serve/mesh_devices", self.engine.mesh.size)
+        if self.engine.blocks is not None:
+            telemetry.set_gauge(
+                "serve/params_gb_per_device",
+                layouts.tree_bytes_per_device(
+                    (self.engine.blocks, self.engine.embed,
+                     self.engine.ln_f)
+                ) / 2**30,
+            )
         if warmup and not self.warmed:
             if self.engine.serve.scheduler == "slots":
                 latencies = self.batcher.warmup()

@@ -99,6 +99,8 @@ class SlotPoolRuntime:
     and the compiled prefill/step executables."""
 
     def __init__(self, engine, num_slots: Optional[int] = None):
+        import functools
+
         import jax
 
         from trlx_tpu.models.generation import (
@@ -107,6 +109,7 @@ class SlotPoolRuntime:
             init_slot_pool,
             init_slot_state,
         )
+        from trlx_tpu.serve import layouts
 
         self.engine = engine
         self.num_slots = engine.slot_count() if num_slots is None \
@@ -116,30 +119,65 @@ class SlotPoolRuntime:
         self._vocab = engine.spec.vocab_size
         # CPU has no buffer donation; donating there only prints warnings
         self._donate = jax.default_backend() != "cpu"
+        #: the serve mesh (engine-owned); every executable compiles with
+        #: explicit in/out shardings on it, so a tp/fsdp slice and the
+        #: default single-device mesh run the SAME code path
+        self.mesh = engine.mesh
+        self._host_sharding = layouts.replicated(self.mesh)
         if self.kv_layout == "paged":
             self.page_size = engine.page_size_tokens()
             self.max_pages = engine.pages_per_slot()
             self.num_pages = engine.page_count()
             # logical per-slot extent rounds UP to whole pages
             self.buffer_len = self.max_pages * self.page_size
-            self.pool = init_page_pool(
-                engine.spec, self._seg_sizes, self.num_pages,
-                self.page_size,
+            self._init_pool = functools.partial(
+                init_page_pool, engine.spec, self._seg_sizes,
+                self.num_pages, self.page_size,
             )
         else:
             self.page_size = self.max_pages = self.num_pages = 0
             self.buffer_len = engine.slot_buffer_len()
-            self.pool = init_slot_pool(
-                engine.spec, self._seg_sizes, self.num_slots,
-                self.buffer_len,
+            self._init_pool = functools.partial(
+                init_slot_pool, engine.spec, self._seg_sizes,
+                self.num_slots, self.buffer_len,
             )
-        self.state = init_slot_state(
-            self.num_slots, self.buffer_len, self._vocab,
+        self._init_state = functools.partial(
+            init_slot_state, self.num_slots, self.buffer_len, self._vocab,
             max_pages=self.max_pages or None,
         )
+        # KV pages shard on the head dim under tp; the per-slot lanes
+        # (and page tables — host data, never shape) replicate. Built
+        # DIRECTLY sharded via jitted init + out_shardings: no device
+        # ever materializes the whole pool, and the first buffers already
+        # carry the shardings the executables are compiled against (a
+        # later reshard would be a steady-state signature change — a
+        # recompile).
+        self._pool_shardings = layouts.kv_pool_shardings(
+            self.mesh, jax.eval_shape(self._init_pool)
+        )
+        self._state_shardings = layouts.replicated_like(
+            self.mesh, jax.eval_shape(self._init_state)
+        )
+        self.pool = jax.jit(
+            self._init_pool, out_shardings=self._pool_shardings
+        )()
+        self.state = jax.jit(
+            self._init_state, out_shardings=self._state_shardings
+        )()
         self._prefill_fns = {}  # (Bp, P[, suffix]) -> aot_jit'd closure
         self._step_fn = None
         self.warmed = False
+
+    def _view_shardings(self):
+        """The live decode views' actual shardings (engine._install_params
+        placed them on the serve mesh) — pinned as executable
+        in_shardings; hot-swap re-puts onto the same shardings, so the
+        signatures never drift."""
+        import jax
+
+        sh = lambda t: jax.tree_util.tree_map(lambda x: x.sharding, t)
+        e = self.engine
+        return sh(e.blocks), sh(e.embed), sh(e.ln_f)
 
     # -- compiled closures ----------------------------------------------- #
 
@@ -173,8 +211,21 @@ class SlotPoolRuntime:
                         mask, slot_ids, max_new, compute_dtype=compute,
                     )
 
+            # host args (tokens/mask/slot_ids/max_new[/tables/start])
+            # replicate; pool + state keep their build shardings in AND
+            # out — the step loop's signatures are pinned, so
+            # compile/recompiles == 0 survives the mesh
+            n_host = 6 if self.kv_layout == "paged" else 4
             fn = self._prefill_fns[key] = aot_jit(
                 run, donate_argnums=(3, 4) if self._donate else (),
+                in_shardings=(
+                    *self._view_shardings(),
+                    self._pool_shardings, self._state_shardings,
+                    *([self._host_sharding] * n_host),
+                ),
+                out_shardings=(
+                    self._pool_shardings, self._state_shardings
+                ),
             )
         return fn
 
@@ -195,6 +246,16 @@ class SlotPoolRuntime:
 
             self._step_fn = aot_jit(
                 run, donate_argnums=(3, 4) if self._donate else (),
+                in_shardings=(
+                    *self._view_shardings(),
+                    self._pool_shardings, self._state_shardings,
+                    self._host_sharding,
+                ),
+                out_shardings=(
+                    self._pool_shardings, self._state_shardings,
+                    self._host_sharding, self._host_sharding,
+                    self._host_sharding,
+                ),
             )
         return self._step_fn
 
@@ -256,14 +317,9 @@ class SlotPoolRuntime:
         2x the pool in HBM mid-reset. The one case the old arrays cannot
         be trusted is donation: a program that failed mid-execution may
         have CONSUMED the donated buffers — detected per-leaf via
-        ``is_deleted()``, and only then is the pool reallocated."""
+        ``is_deleted()``, and only then is the pool reallocated (on its
+        original mesh shardings — a reset never drifts a signature)."""
         import jax
-
-        from trlx_tpu.models.generation import (
-            init_page_pool,
-            init_slot_pool,
-            init_slot_state,
-        )
 
         def consumed(leaf):
             try:
@@ -272,20 +328,12 @@ class SlotPoolRuntime:
                 return True  # uninspectable -> rebuild, the safe side
 
         if any(consumed(x) for x in jax.tree_util.tree_leaves(self.pool)):
-            if self.kv_layout == "paged":
-                self.pool = init_page_pool(
-                    self.engine.spec, self._seg_sizes, self.num_pages,
-                    self.page_size,
-                )
-            else:
-                self.pool = init_slot_pool(
-                    self.engine.spec, self._seg_sizes, self.num_slots,
-                    self.buffer_len,
-                )
-        self.state = init_slot_state(
-            self.num_slots, self.buffer_len, self._vocab,
-            max_pages=self.max_pages or None,
-        )
+            self.pool = jax.jit(
+                self._init_pool, out_shardings=self._pool_shardings
+            )()
+        self.state = jax.jit(
+            self._init_state, out_shardings=self._state_shardings
+        )()
 
     # -- warmup ------------------------------------------------------------ #
 
@@ -789,10 +837,19 @@ class SlotScheduler:
                 )
 
     def pool_stats(self) -> Dict:
-        """Host view of the KV pool — the /healthz ``kv`` block."""
+        """Host view of the KV pool — the /healthz ``kv`` block. Under a
+        tp mesh every device holds a head-slice of EVERY page (tables are
+        replicated host data), so the per-device footprint is the pool
+        bytes over tp while page counts stay global."""
+        from trlx_tpu.serve import layouts
+
         stats = {
             "kv_layout": self.runtime.kv_layout,
             "slots": self.runtime.num_slots,
+            "pool_gb_per_device": round(
+                layouts.tree_bytes_per_device(self.runtime.pool) / 2**30,
+                6,
+            ),
         }
         if self.cache is not None:
             stats.update(
@@ -1229,6 +1286,7 @@ class SlotScheduler:
             ),
             "flight_dumps": self.flight.dumps if self.flight else 0,
             "kv": self.pool_stats(),
+            "mesh": self.engine.mesh_info(),
         }
 
     def _run(self) -> None:

@@ -303,3 +303,52 @@ def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, An
 
     telemetry.inc("checkpoint/restores")
     return out
+
+
+def restore_component_sharded(
+    name: str, template: Any, shardings: Any, directory: str
+) -> Any:
+    """Partial, streaming restore of ONE array component.
+
+    ``template`` is a ShapeDtypeStruct pytree covering a SUBSET of the
+    stored tree (e.g. the serve-side decode views without the reference
+    branch / value head); subtrees absent from it are never read off
+    disk. Each leaf restores straight into a device buffer under its
+    entry in ``shardings`` (a matching NamedSharding pytree), so host
+    staging is Orbax's per-leaf pipeline — peak ~one leaf, never the
+    whole tree — and a tp/fsdp-sharded engine reads only its shards of
+    each leaf. ``directory`` resolves like :func:`restore_components`
+    (checkpoint dir or run dir)."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    resolved = _resolve_restore_dir(directory)
+    if resolved is None:
+        raise FileNotFoundError(
+            f"no committed checkpoint at '{directory}' to restore "
+            f"'{name}' from (expected a checkpoint dir with "
+            f"'{META_NAME}', or a run dir of 'step_<N>' checkpoints)"
+        )
+    path = os.path.join(resolved, name)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"checkpoint '{resolved}' has no array component '{name}' "
+            f"(found on disk: {sorted(os.listdir(resolved))})"
+        )
+    restore_args = jax.tree_util.tree_map(
+        lambda sds, sh: ocp.ArrayRestoreArgs(
+            sharding=sh, dtype=getattr(sds, "dtype", None)
+        ),
+        template, shardings,
+    )
+    with ocp.PyTreeCheckpointer() as ckptr:
+        # transforms={} switches Orbax to lazy per-key matching, which is
+        # what makes the ITEM-IS-A-SUBSET restore legal (without it the
+        # tree structures must match exactly)
+        out = ckptr.restore(
+            path, item=template, restore_args=restore_args, transforms={}
+        )
+    from trlx_tpu import telemetry
+
+    telemetry.inc("checkpoint/restores")
+    return out
