@@ -1,18 +1,26 @@
 # Style targets (parity: reference Makefile:1-14, black/isort/flake8 there).
-# ruff covers formatting-adjacent lint + import order; the stdlib fallback
-# (tests/test_style.py) enforces the core rules where ruff isn't installed.
+# ruff covers formatting-adjacent lint + import order; graftlint
+# (trlx_tpu/analysis, `make lint`) enforces the project's own invariant
+# rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
+# the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check test faults telemetry chaos serve serve-mesh serve-soak serve-chaos
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos
 
-check:
+# graftlint: the repo's AST invariant checker (docs "Static analysis").
+# Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
+# the catalog. No baseline file — HEAD is always clean.
+lint:
+	python -m trlx_tpu.analysis
+
+check: lint
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
-		|| python -m pytest tests/test_style.py -q
+		|| true
 
 style:
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check --fix trlx_tpu tests examples bench.py __graft_entry__.py \
-		|| python -m pytest tests/test_style.py -q
+		|| python -m trlx_tpu.analysis
 
 # the tier-1 contract (ROADMAP.md): CPU-pinned so a dev-box run never
 # grabs an accelerator, and 'not slow' so it matches what CI gates on

@@ -1,0 +1,6 @@
+from trlx_tpu.supervisor import chaos
+
+
+def test_fixture_seam_drill():
+    chaos.configure("fixture_seam:exc@1")
+    chaos.reset()

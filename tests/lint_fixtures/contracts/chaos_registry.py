@@ -1,0 +1,3 @@
+KNOWN_SEAMS = (
+    "fixture_seam",
+)

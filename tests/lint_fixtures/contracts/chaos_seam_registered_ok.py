@@ -1,0 +1,6 @@
+from trlx_tpu.supervisor import chaos
+
+
+def admit(batch):
+    chaos.maybe_inject("fixture_seam")
+    return batch

@@ -1,0 +1,12 @@
+from trlx_tpu import telemetry
+
+_COUNTERS = ("fault/fixture_trip",)
+
+
+def start():
+    telemetry.predeclare(_COUNTERS)
+
+
+def record(value):
+    telemetry.observe("serve/fixture_latency", value)
+    telemetry.inc("fault/fixture_trip")

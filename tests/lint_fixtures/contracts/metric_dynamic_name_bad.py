@@ -1,0 +1,5 @@
+from trlx_tpu import telemetry
+
+
+def record(kind, value):
+    telemetry.observe(f"serve/latency_{kind}", value)

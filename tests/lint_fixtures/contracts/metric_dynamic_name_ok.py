@@ -1,0 +1,5 @@
+from trlx_tpu import telemetry
+
+
+def record(value):
+    telemetry.observe("serve/latency_slots", value)

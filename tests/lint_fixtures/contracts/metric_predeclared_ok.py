@@ -1,0 +1,11 @@
+from trlx_tpu import telemetry
+
+_COUNTERS = ("serve/fixture_ghost",)
+
+
+def start():
+    telemetry.predeclare(_COUNTERS)
+
+
+def record():
+    telemetry.inc("serve/fixture_ghost")

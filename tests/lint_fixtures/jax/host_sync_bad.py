@@ -1,0 +1,12 @@
+import jax
+import numpy as np
+
+
+@jax.jit
+def to_host(x):
+    return float(np.asarray(x))
+
+
+@jax.jit
+def read_scalar(x):
+    return x.item()

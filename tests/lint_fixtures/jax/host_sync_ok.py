@@ -1,0 +1,10 @@
+import jax
+
+
+@jax.jit
+def scale(x):
+    return x * float(x.shape[0])
+
+
+def pull(x):
+    return float(jax.device_get(x))

@@ -1,0 +1,8 @@
+import jax
+
+
+def compile_all(fns):
+    out = []
+    for fn in fns:
+        out.append(jax.jit(fn))
+    return out

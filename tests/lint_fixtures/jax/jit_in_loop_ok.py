@@ -1,0 +1,13 @@
+import jax
+
+
+def make_runner(fn):
+    step = jax.jit(fn)
+
+    def run(xs):
+        out = []
+        for x in xs:
+            out.append(step(x))
+        return out
+
+    return run

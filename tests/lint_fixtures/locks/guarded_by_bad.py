@@ -1,0 +1,10 @@
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+
+    def push(self, item):
+        self._items.append(item)

@@ -1,0 +1,14 @@
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def _push_locked(self, item):  # holds: _lock
+        self._items.append(item)

@@ -1,0 +1,11 @@
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def set(self, v):
+        with self._lock:
+            self.value = v

@@ -1,0 +1,10 @@
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return key

@@ -1,0 +1,8 @@
+import time
+from time import perf_counter
+
+
+def measure(fn):
+    start = time.time()
+    fn()
+    return perf_counter() - start

@@ -1,0 +1,6 @@
+from trlx_tpu import telemetry
+
+
+def measure(fn):
+    with telemetry.span("fixture/measure"):
+        fn()

@@ -1,0 +1,5 @@
+def load(path):
+    try:
+        return path.read_text()
+    except OSError:
+        return ""

@@ -1,0 +1,5 @@
+from trlx_tpu.supervisor import monotonic
+
+
+def stamp():
+    return monotonic()

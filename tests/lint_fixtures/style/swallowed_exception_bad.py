@@ -1,0 +1,5 @@
+def save(path, data):
+    try:
+        path.write_text(data)
+    except OSError:
+        pass

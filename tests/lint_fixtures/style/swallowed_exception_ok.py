@@ -1,0 +1,6 @@
+def save(path, data):
+    try:
+        path.write_text(data)
+    except OSError as err:
+        print(f"save failed: {err}")
+        raise

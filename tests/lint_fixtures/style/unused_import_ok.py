@@ -1,0 +1,6 @@
+import json
+import sys as _sys  # noqa: F401  (deliberate re-export shim)
+
+__all__ = ["json"]
+
+print(json.dumps({}))

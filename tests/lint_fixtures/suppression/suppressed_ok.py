@@ -1,0 +1,7 @@
+def check(x):
+    return x == None  # lint: disable=none-comparison -- fixture: sentinel type defines __eq__ on purpose
+
+
+def check_standalone(x):
+    # lint: disable=none-comparison -- fixture: waiver on the line above the statement
+    return x == None

@@ -1,0 +1,262 @@
+"""Unit tests for graftlint (``trlx_tpu.analysis``): every rule fires
+on its planted-bad fixture and stays quiet on the closest compliant
+spelling, suppressions work only with a justification, and the CLI's
+exit codes are what ``make lint`` relies on.
+
+Fixtures live in tests/lint_fixtures/ (excluded from the real lint
+surface); each test mounts them into an in-memory ProjectModel under a
+synthetic repo-relative path, so path-scoped rules (library-only,
+serve-only) see the tree shape they expect without touching real files.
+The whole-repo run is tests/test_style.py's job.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from trlx_tpu.analysis import RULES, run_rules
+from trlx_tpu.analysis.model import OBSERVABILITY_DOC, ProjectModel
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+
+#: default synthetic mount point: a plain library module
+LIB = "trlx_tpu/mod.py"
+#: where the chaos registry fixture gets mounted (mirrors the real one)
+REGISTRY = "trlx_tpu/supervisor/chaos.py"
+
+
+def fixture(rel: str) -> str:
+    return (FIXTURES / rel).read_text()
+
+
+def lint(files, select, docs=None):
+    return run_rules(ProjectModel(files=files, docs=docs), select=select)
+
+
+# --------------------------------------------------------------------- #
+# one bad/ok pair per single-file rule
+# --------------------------------------------------------------------- #
+
+SIMPLE = [
+    ("syntax-error", "style/syntax_error", LIB),
+    ("unused-import", "style/unused_import", LIB),
+    ("none-comparison", "style/none_comparison", LIB),
+    ("trailing-whitespace", "style/trailing_whitespace", LIB),
+    ("tab-indent", "style/tab_indent", LIB),
+    ("bare-except", "style/bare_except", LIB),
+    ("swallowed-exception", "style/swallowed_exception", LIB),
+    ("adhoc-timing", "style/adhoc_timing", LIB),
+    ("serve-clock", "style/serve_clock", "trlx_tpu/serve/mod.py"),
+    ("use-after-donate", "jax/use_after_donate", LIB),
+    ("host-sync-in-jit", "jax/host_sync", LIB),
+    ("jit-in-loop", "jax/jit_in_loop", LIB),
+    ("lazy-lock", "locks/lazy_lock", LIB),
+    ("guarded-by", "locks/guarded_by", LIB),
+    ("guarded-by-unknown", "locks/guarded_by_unknown", LIB),
+    ("metric-dynamic-name", "contracts/metric_dynamic_name", LIB),
+]
+
+
+@pytest.mark.parametrize("rule,stem,path", SIMPLE,
+                         ids=[case[0] for case in SIMPLE])
+def test_rule_fires_on_planted_bad(rule, stem, path):
+    findings = lint({path: fixture(f"{stem}_bad.py")}, select=[rule])
+    assert findings, f"{rule} did not fire on {stem}_bad.py"
+    assert all(f.rule == rule for f in findings)
+    assert all(f.file == path and f.line > 0 for f in findings)
+    assert findings[0].hint, "every finding carries a fix hint"
+    assert f"{path}:{findings[0].line}" in findings[0].render()
+
+
+@pytest.mark.parametrize("rule,stem,path", SIMPLE,
+                         ids=[case[0] for case in SIMPLE])
+def test_rule_quiet_on_clean(rule, stem, path):
+    findings = lint({path: fixture(f"{stem}_ok.py")}, select=[rule])
+    assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# path scoping: the same bad content is legal where the rule says so
+# --------------------------------------------------------------------- #
+
+def test_library_only_rules_skip_the_tests_tree():
+    src = fixture("style/bare_except_bad.py") + fixture(
+        "style/swallowed_exception_bad.py"
+    )
+    findings = lint(
+        {"tests/test_mod.py": src},
+        select=["bare-except", "swallowed-exception"],
+    )
+    assert findings == []
+
+
+def test_adhoc_timing_allowed_where_timing_is_the_job():
+    for path in (
+        "trlx_tpu/telemetry/mod.py",
+        "trlx_tpu/supervisor/mod.py",
+        "trlx_tpu/analysis/mod.py",
+        "trlx_tpu/utils/__init__.py",
+    ):
+        findings = lint(
+            {path: fixture("style/adhoc_timing_bad.py")},
+            select=["adhoc-timing"],
+        )
+        assert findings == [], path
+
+
+def test_serve_clock_only_fires_under_serve():
+    findings = lint(
+        {"trlx_tpu/core.py": fixture("style/serve_clock_bad.py")},
+        select=["serve-clock"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# contract sync: the acceptance-criteria fixtures
+# --------------------------------------------------------------------- #
+
+def test_metric_predeclared_fires_without_predeclaration():
+    findings = lint(
+        {LIB: fixture("contracts/metric_predeclared_bad.py")},
+        select=["metric-predeclared"],
+    )
+    assert [f.rule for f in findings] == ["metric-predeclared"]
+    assert "serve/fixture_ghost" in findings[0].message
+
+
+def test_metric_predeclared_resolves_module_tuple_constants():
+    findings = lint(
+        {LIB: fixture("contracts/metric_predeclared_ok.py")},
+        select=["metric-predeclared"],
+    )
+    assert findings == []
+
+
+def test_metric_catalog_sync_fails_build_on_missing_doc_entry():
+    """The acceptance fixture: serve/* and fault/* names emitted but
+    absent from observability.rst each produce a finding (a non-empty
+    finding list is exit 1 — a failed ``make lint``)."""
+    files = {LIB: fixture("contracts/metric_documented.py")}
+    findings = lint(files, select=["metric-documented"])
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert flagged == {"serve/fixture_latency", "fault/fixture_trip"}
+
+
+def test_metric_catalog_sync_clean_when_catalogued():
+    files = {LIB: fixture("contracts/metric_documented.py")}
+    docs = {OBSERVABILITY_DOC: (
+        ".. list-table::\n"
+        "   * - ``serve/fixture_latency``\n"
+        "   * - ``fault/fixture_trip``\n"
+    )}
+    assert lint(files, select=["metric-documented"], docs=docs) == []
+    # and the full rule set agrees: predeclared + documented = clean
+    assert lint(files, select=None, docs=docs) == []
+
+
+def test_chaos_seam_registered_fires_on_unknown_seam():
+    files = {
+        REGISTRY: fixture("contracts/chaos_registry.py"),
+        "trlx_tpu/serve/mod.py":
+            fixture("contracts/chaos_seam_registered_bad.py"),
+    }
+    findings = lint(files, select=["chaos-seam-registered"])
+    assert len(findings) == 1
+    assert "fixture_mystery" in findings[0].message
+
+
+def test_chaos_seam_registered_quiet_on_registered_seam():
+    files = {
+        REGISTRY: fixture("contracts/chaos_registry.py"),
+        "trlx_tpu/serve/mod.py":
+            fixture("contracts/chaos_seam_registered_ok.py"),
+    }
+    assert lint(files, select=["chaos-seam-registered"]) == []
+
+
+def test_chaos_seam_tested_fires_when_no_drill_exists():
+    files = {REGISTRY: fixture("contracts/chaos_registry.py")}
+    findings = lint(files, select=["chaos-seam-tested"])
+    assert len(findings) == 1
+    assert "fixture_seam" in findings[0].message
+
+
+def test_chaos_seam_tested_quiet_with_a_drill():
+    files = {
+        REGISTRY: fixture("contracts/chaos_registry.py"),
+        "tests/test_fixture_drill.py":
+            fixture("contracts/chaos_drill.py"),
+    }
+    assert lint(files, select=["chaos-seam-tested"]) == []
+
+
+# --------------------------------------------------------------------- #
+# suppressions
+# --------------------------------------------------------------------- #
+
+def test_justified_suppression_is_honored():
+    findings = lint(
+        {LIB: fixture("suppression/suppressed_ok.py")},
+        select=["none-comparison"],
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unjustified_suppression_reports_and_does_not_suppress():
+    findings = lint(
+        {LIB: fixture("suppression/suppressed_bad.py")},
+        select=["none-comparison", "bad-suppression"],
+    )
+    assert sorted(f.rule for f in findings) == [
+        "bad-suppression", "none-comparison",
+    ]
+
+
+def test_bad_suppression_cannot_suppress_itself():
+    src = "x = 1  # lint: disable=bad-suppression\n"
+    findings = lint({LIB: src}, select=["bad-suppression"])
+    assert [f.rule for f in findings] == ["bad-suppression"]
+
+
+# --------------------------------------------------------------------- #
+# registry + engine surface
+# --------------------------------------------------------------------- #
+
+def test_rule_catalog_metadata_is_complete():
+    run_rules(ProjectModel(files={}))  # force rule registration
+    assert len(RULES) >= 20
+    assert {r.family for r in RULES.values()} == {
+        "style", "jax", "locks", "contracts",
+    }
+    for rule in RULES.values():
+        assert rule.id and rule.family and rule.rationale and rule.hint
+
+
+def test_unknown_select_is_a_loud_error():
+    with pytest.raises(ValueError, match="no-such-rule"):
+        run_rules(ProjectModel(files={}), select=["no-such-rule"])
+
+
+def test_cli_exit_codes(tmp_path):
+    """``make lint`` contract: 1 with findings on stdout, 0 when clean."""
+    lib = tmp_path / "trlx_tpu"
+    lib.mkdir()
+    (lib / "mod.py").write_text(fixture("style/none_comparison_bad.py"))
+    (lib / "metrics.py").write_text(
+        fixture("contracts/metric_predeclared_bad.py")
+    )
+    cmd = [sys.executable, "-m", "trlx_tpu.analysis", str(tmp_path)]
+    bad = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    assert bad.returncode == 1
+    assert "none-comparison" in bad.stdout
+    assert "metric-predeclared" in bad.stdout
+
+    (lib / "mod.py").write_text(fixture("style/none_comparison_ok.py"))
+    (lib / "metrics.py").write_text("")
+    good = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    assert good.returncode == 0, good.stdout + good.stderr
+    assert "clean" in good.stdout

@@ -253,6 +253,35 @@ def test_ilql_randomwalks_learns():
     )
 
 
+def test_ilql_update_chaos_drill_fires_inside_update_loop():
+    """Chaos drill for the ``ilql_update`` seam (the KNOWN_SEAMS
+    registry requires every seam be exercised by a test — graftlint
+    chaos-seam-tested): an ``exc@1`` injection must surface from
+    ``learn()`` out of the real update loop, BEFORE the first parameter
+    update commits."""
+    from trlx_tpu.supervisor import chaos
+    from trlx_tpu.utils.loading import get_model, get_orchestrator
+
+    walks, logit_mask, stats_fn, reward_fn = generate_random_walks(seed=1002)
+    n_nodes = logit_mask.shape[0]
+    trainer = get_model("JaxILQLTrainer")(
+        rw_config(n_nodes), logit_mask=logit_mask
+    )
+    eval_prompts = np.arange(1, n_nodes).reshape(-1, 1)
+    get_orchestrator("OfflineOrchestrator")(
+        trainer, walks, eval_prompts, reward_fn=reward_fn, stats_fn=stats_fn
+    )
+    params_before = trainer.params
+    chaos.configure("ilql_update:exc@1")
+    try:
+        with pytest.raises(chaos.ChaosError):
+            trainer.learn(log_fn=lambda s: None)
+    finally:
+        chaos.reset()
+    # the seam sits before the train-step dispatch: nothing committed
+    assert trainer.params is params_before
+
+
 def test_evaluate_caps_eval_set_at_128():
     """In-loop evaluate() must bound its cost like the reference's
     128-row tables (reference: accelerate_ilql_model.py:128-157), while

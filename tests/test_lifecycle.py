@@ -211,6 +211,40 @@ def test_hot_swap_probe_rollback_keeps_serving():
         telemetry.start()
 
 
+def test_hot_swap_chaos_reload_fault_rolls_back_then_recovers():
+    """Chaos drill for the ``serve_reload`` seam (KNOWN_SEAMS contract,
+    graftlint chaos-seam-tested): an injected fault at swap application
+    rolls back to the old weights and keeps serving; the NEXT swap on
+    the same scheduler — the ``@1`` occurrence consumed — commits."""
+    engine = build_engine(page_size=4)
+    registry = telemetry.current().registry
+    s = SlotScheduler(engine)
+    s.warmup()
+    s.start()
+    chaos.configure("serve_reload:exc@1")
+    try:
+        good = s.submit([1, 2, 3], max_new_tokens=2)
+        good.wait(timeout=30.0)
+        res = s.request_swap(engine._init_params(), label="drill")
+        assert res["reloaded"] is False
+        assert "ChaosError" in res["reason"]
+        assert engine.model_version == 1
+        assert registry.counters["serve/reload_failures"] >= 1.0
+        # rollback kept the OLD weights serving bit-identically
+        again = s.submit([1, 2, 3], max_new_tokens=2)
+        again.wait(timeout=30.0)
+        assert again.result == good.result, (
+            "chaos rollback did not restore the serving weights"
+        )
+        res2 = s.request_swap(engine._init_params(), label="recovered")
+        assert res2["reloaded"] is True
+        assert engine.model_version == 2
+    finally:
+        chaos.reset()
+        s.stop()
+        telemetry.start()
+
+
 # --------------------------------------------------------------------- #
 # HTTP lifecycle e2e: drain under load, Retry-After, hot-swap under load
 # --------------------------------------------------------------------- #

@@ -1,197 +1,52 @@
-"""Stdlib lint: the core style rules `make check` enforces, runnable with
-plain pytest in environments where ruff cannot be installed (no egress).
+"""Tier-1 bridge into graftlint (``trlx_tpu.analysis``).
 
-Covers the highest-signal subset of the configured ruff rules
-(pyproject.toml [tool.ruff]): files must parse, no unused module-level
-imports (F401, minus `# noqa` re-export shims), no tabs in indentation,
-no trailing whitespace, and no `== None` / `!= None` comparisons (E711).
+This file used to BE the lint engine — ad-hoc AST walkers for the
+highest-signal ruff subset plus the project's own invariants (timing
+discipline, serve-path clock ban, exception swallowing). Those walkers
+now live as registered rules in ``trlx_tpu/analysis/rules/`` alongside
+the JAX-hazard, lock-discipline, and telemetry/chaos-contract families,
+and this module is a thin parametrized runner over the one engine:
+one ``test_lint[<relpath>]`` id per checked file (same ids as before,
+so tier-1 selection and bisect history stay stable), failing with the
+rendered findings for that file.
 
-Library-only rules (trlx_tpu/): no bare ``except:`` and no
-exception-swallowing ``except ...: pass`` handlers. The reference's
-checkpoint save/load wrapped everything in try/except-pass — which is
-exactly how its checkpointing shipped dead and nobody noticed (SURVEY
-§3.6). A handler must re-raise, return, log, or otherwise DO something
-with the failure. And no ad-hoc ``time.time()`` / ``time.perf_counter()``
-deltas outside ``utils/__init__.py`` (Clock) and ``telemetry/`` — all new
-timing goes through the telemetry registry so it reaches the metrics
-stream instead of dying in a local variable.
+The rules themselves — positive AND negative fixtures per rule,
+suppression handling, the contract-sync acceptance cases — are
+unit-tested in tests/test_graftlint.py.
 """
 
-import ast
 import pathlib
 
 import pytest
 
+from trlx_tpu.analysis import run_lint
+from trlx_tpu.analysis.model import ProjectModel
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = sorted(
-    p
-    for root in ("trlx_tpu", "tests", "examples")
-    for p in (REPO / root).rglob("*.py")
-) + [REPO / "bench.py", REPO / "__graft_entry__.py"]
+
+# One parse + one rule pass for the whole repo at collection time (the
+# lint is whole-project: cross-file rules need every file anyway), then
+# findings fan out to per-file test ids.
+_MODEL = ProjectModel.from_repo(REPO)
+TARGETS = sorted(_MODEL.files)
+_FINDINGS, _ = run_lint(project=_MODEL)
+_BY_FILE = {}
+for _f in _FINDINGS:
+    _BY_FILE.setdefault(_f.file, []).append(_f)
 
 
-def _used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-    # __all__ strings count as uses
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    for el in ast.walk(node.value):
-                        if isinstance(el, ast.Constant) and isinstance(
-                            el.value, str
-                        ):
-                            used.add(el.value)
-    return used
-
-
-@pytest.mark.parametrize("path", TARGETS, ids=lambda p: str(p.relative_to(REPO)))
+@pytest.mark.parametrize("path", TARGETS)
 def test_lint(path):
-    src = path.read_text()
-    lines = src.splitlines()
-    problems = []
+    findings = _BY_FILE.get(path, [])
+    assert not findings, "\n" + "\n".join(f.render() for f in findings)
 
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:  # pragma: no cover
-        pytest.fail(f"{path}: does not parse: {e}")
 
-    used = _used_names(tree)
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Import, ast.ImportFrom)):
-            continue
-        if getattr(node, "module", "") == "__future__":
-            continue
-        line = lines[node.lineno - 1]
-        if "noqa" in line:
-            continue
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            bound = (alias.asname or alias.name).split(".")[0]
-            if bound not in used:
-                problems.append(
-                    f"line {node.lineno}: unused import '{bound}' (F401)"
-                )
-
-    for i, line in enumerate(lines, 1):
-        stripped = line.rstrip("\n")
-        if stripped != stripped.rstrip():
-            problems.append(f"line {i}: trailing whitespace (W291)")
-        if stripped[: len(stripped) - len(stripped.lstrip())].count("\t"):
-            problems.append(f"line {i}: tab in indentation (W191)")
-
-    lib = REPO / "trlx_tpu"
-    if lib in path.parents:
-        # all timing goes through Clock (utils/__init__.py), the
-        # telemetry registry/tracer, or the run supervisor's watchdog
-        # clock (supervisor/ — its timing IS the supervision mechanism
-        # and surfaces as fault/* counters): ad-hoc time.time()/
-        # perf_counter() deltas are exactly the opaque instrumentation
-        # the unified telemetry layer replaced (docs "Observability").
-        # Every other package — trlx_tpu/serve/ explicitly included, so
-        # the serving subsystem inherits the gate from day one — must
-        # source clocks from those modules (the batcher's flush-deadline
-        # clock is supervisor.monotonic).
-        timing_allowed = (
-            path == lib / "utils" / "__init__.py"
-            or (lib / "telemetry") in path.parents
-            or (lib / "supervisor") in path.parents
-        )
-        if not timing_allowed:
-            # names bound by `from time import ...` (the evasion the
-            # attribute check below would miss)
-            time_fns = ("time", "perf_counter", "monotonic")
-            from_time = set()
-            for node in ast.walk(tree):
-                if isinstance(node, ast.ImportFrom) and node.module == "time":
-                    for alias in node.names:
-                        if alias.name in time_fns:
-                            from_time.add(alias.asname or alias.name)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                hit = None
-                if (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in time_fns
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "time"
-                ):
-                    hit = f"time.{node.func.attr}"
-                elif (
-                    isinstance(node.func, ast.Name)
-                    and node.func.id in from_time
-                ):
-                    hit = node.func.id
-                if hit:
-                    problems.append(
-                        f"line {node.lineno}: ad-hoc {hit}() timing — "
-                        f"use trlx_tpu.telemetry.span()/observe() (or "
-                        f"utils.Clock / supervisor.monotonic for "
-                        f"control-flow deadlines) so the measurement "
-                        f"reaches the metrics stream"
-                    )
-        if (lib / "serve") in path.parents:
-            # the serve path is stricter still: request traces do
-            # arithmetic across timestamps stamped by different threads
-            # (HTTP edge, scheduler worker), which is only sound if every
-            # one comes from the SAME clock — supervisor.monotonic. Ban
-            # the `time`/`datetime` modules outright so a mixed-clock
-            # TTFT can't be introduced by an innocent-looking import.
-            for node in ast.walk(tree):
-                banned = None
-                if isinstance(node, ast.Import):
-                    for alias in node.names:
-                        if alias.name.split(".")[0] in ("time", "datetime"):
-                            banned = alias.name
-                elif isinstance(node, ast.ImportFrom):
-                    if (node.module or "").split(".")[0] in (
-                        "time", "datetime"
-                    ):
-                        banned = node.module
-                if banned:
-                    problems.append(
-                        f"line {node.lineno}: serve-path import of "
-                        f"'{banned}' — serve code records wall-clock "
-                        f"times only via trlx_tpu.supervisor.monotonic "
-                        f"(one clock source keeps trace arithmetic "
-                        f"sound; see trlx_tpu/serve/trace.py)"
-                    )
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if node.type is None:
-                problems.append(
-                    f"line {node.lineno}: bare 'except:' (E722) — name "
-                    f"the exception; the reference's swallowed-exception "
-                    f"checkpointing is the bug class this forbids"
-                )
-            elif all(isinstance(stmt, ast.Pass) for stmt in node.body):
-                problems.append(
-                    f"line {node.lineno}: exception-swallowing "
-                    f"'except ...: pass' — re-raise, return a fallback, "
-                    f"or log the failure"
-                )
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                    isinstance(comp, ast.Constant) and comp.value is None
-                ):
-                    problems.append(
-                        f"line {node.lineno}: comparison to None with "
-                        f"==/!= (E711)"
-                    )
-
-    assert not problems, f"{path.relative_to(REPO)}:\n" + "\n".join(problems)
+def test_lint_covers_whole_repo():
+    """The target set didn't silently shrink: every source root the old
+    walker covered is still represented, and no finding points outside
+    the checked set."""
+    prefixes = {t.split("/")[0] for t in TARGETS if "/" in t}
+    assert {"trlx_tpu", "tests", "examples"} <= prefixes
+    assert "bench.py" in TARGETS
+    assert "__graft_entry__.py" in TARGETS
+    assert set(_BY_FILE) <= set(TARGETS)

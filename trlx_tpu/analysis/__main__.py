@@ -1,0 +1,59 @@
+"""CLI: ``python -m trlx_tpu.analysis [root] [--select a,b] [...]``.
+
+Exit status 0 = clean, 1 = findings, 2 = usage error. Deliberately
+free of jax/numpy imports so ``make lint`` stays a sub-second pure-AST
+pass.
+"""
+
+import argparse
+import sys
+
+from trlx_tpu.analysis import RULES, _load_rules, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.analysis",
+        description="graftlint — the repo's AST invariant checker",
+    )
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--select", default=None, metavar="RULE[,RULE]",
+                    help="run only these rule ids")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        _load_rules()
+        fam = ""
+        for rule in sorted(RULES.values(),
+                           key=lambda r: (r.family, r.id)):
+            if rule.family != fam:
+                fam = rule.family
+                print(f"\n[{fam}]")
+            print(f"  {rule.id:24s} {rule.rationale.split(';')[0]}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        findings, project = run_lint(root=args.root, select=select)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.render())
+    nfiles = len(project.files)
+    if findings:
+        bad = len({f.file for f in findings})
+        print(f"\n{len(findings)} finding(s) in {bad} of {nfiles} files")
+        return 1
+    print(f"clean: {nfiles} files, {len(RULES)} rules")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

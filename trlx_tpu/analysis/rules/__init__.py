@@ -1,0 +1,8 @@
+"""Rule families. Importing this package registers every rule."""
+
+from trlx_tpu.analysis.rules import (  # noqa: F401  (register on import)
+    contracts,
+    jax_hazards,
+    locks,
+    style,
+)

@@ -1,0 +1,252 @@
+"""Contract-sync rules: telemetry catalog and chaos-seam coverage.
+
+The observability stack works on a closed-world assumption: every
+counter is predeclared (so a Prometheus scrape sees an explicit zero,
+not a gap that breaks rate()), and every ``serve/*`` / ``fault/*`` name
+is in the docs/source/observability.rst catalog operators alert on.
+Likewise every chaos seam named at a call site must be in
+``KNOWN_SEAMS`` (supervisor/chaos.py) and exercised by at least one
+test — a seam nobody injects is a fault path that has never run.
+"""
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from trlx_tpu.analysis import Rule, register
+from trlx_tpu.analysis.model import FileContext, _const_strings
+
+#: counter namespaces under the predeclaration contract
+_COUNTER_PREFIXES = ("serve/", "fault/", "checkpoint/", "chaos/",
+                     "telemetry/", "compile/")
+
+#: namespaces the observability.rst catalog must cover
+_DOC_PREFIXES = ("serve/", "fault/")
+
+_EMITTERS = ("inc", "set_gauge", "observe")
+
+
+def _callee_leaf(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _literal_metric(node: ast.Call) -> Optional[str]:
+    if node.args and isinstance(node.args[0], ast.Constant) and (
+        isinstance(node.args[0].value, str)
+    ):
+        return node.args[0].value
+    return None
+
+
+def _emitted_metrics(ctx: FileContext,
+                     kinds: Tuple[str, ...]) -> Iterable[Tuple[str, int]]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_leaf(node) not in kinds:
+            continue
+        name = _literal_metric(node)
+        if name is not None:
+            yield name, node.lineno
+
+
+class LibraryRule(Rule):
+    """Base: fan out over parsed library files."""
+
+    def run(self, project) -> Iterable:
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_library:
+                continue
+            yield from self.check(ctx, project)
+
+    def check(self, ctx: FileContext, project) -> Iterable:
+        raise NotImplementedError
+
+
+@register
+class MetricPredeclaredRule(LibraryRule):
+    id = "metric-predeclared"
+    family = "contracts"
+    rationale = (
+        "a counter that first exists when it first fires is invisible "
+        "to every scrape before that: rate() sees a gap, dashboards "
+        "show 'no data' instead of 0, and alerts on the absence never "
+        "arm — predeclaration (telemetry.predeclare) is the fix, and "
+        "this rule keeps every inc() site inside it"
+    )
+    hint = (
+        "add the name to the predeclared tuple its subsystem registers "
+        "(_PREDECLARED_COUNTERS, _SERVE_COUNTERS, SLO_COUNTERS) or "
+        "pass it through telemetry.predeclare() at startup"
+    )
+
+    def check(self, ctx, project):
+        declared = project.predeclared_metrics()
+        for name, line in _emitted_metrics(ctx, ("inc",)):
+            if not name.startswith(_COUNTER_PREFIXES):
+                continue
+            if name not in declared:
+                yield self.finding(
+                    ctx, line,
+                    f"counter '{name}' is incremented but never "
+                    f"predeclared — scrapes before the first event "
+                    f"see a gap, not a zero",
+                )
+
+
+@register
+class MetricDocumentedRule(LibraryRule):
+    id = "metric-documented"
+    family = "contracts"
+    rationale = (
+        "docs/source/observability.rst is the catalog operators build "
+        "dashboards and alerts from; a serve/* or fault/* name emitted "
+        "but not catalogued is telemetry nobody will ever look at, and "
+        "the doc silently rots into a partial list"
+    )
+    hint = (
+        "add the metric (name, type, meaning) to the matching table "
+        "in docs/source/observability.rst"
+    )
+
+    def check(self, ctx, project):
+        doc = project.observability_doc()
+        for name, line in _emitted_metrics(ctx, _EMITTERS):
+            if not name.startswith(_DOC_PREFIXES):
+                continue
+            if name not in doc:
+                yield self.finding(
+                    ctx, line,
+                    f"metric '{name}' is emitted but missing from the "
+                    f"observability.rst catalog",
+                )
+
+
+@register
+class MetricDynamicNameRule(LibraryRule):
+    id = "metric-dynamic-name"
+    family = "contracts"
+    rationale = (
+        "an f-string metric name in the serve/ or fault/ namespace "
+        "defeats both contracts above — the checker (and the catalog) "
+        "cannot enumerate names minted at runtime, and unbounded label "
+        "cardinality is the classic way a metrics backend falls over"
+    )
+    hint = (
+        "use a fixed metric name; put the varying part in the value "
+        "or a bounded enum of predeclared names"
+    )
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_leaf(node) not in _EMITTERS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.JoinedStr):
+                continue
+            head = node.args[0].values[0] if node.args[0].values else None
+            if (
+                isinstance(head, ast.Constant)
+                and isinstance(head.value, str)
+                and head.value.startswith(_DOC_PREFIXES)
+            ):
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"dynamic metric name f\"{head.value}...\" — names "
+                    f"in serve//fault/ must be static literals",
+                )
+
+
+def _literal_seams(ctx: FileContext) -> Iterable[Tuple[str, int]]:
+    """Seam names at injection points: maybe_inject("x"), phase("x"),
+    and any seam="x" keyword (retry_call and friends)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _callee_leaf(node)
+        if leaf in ("maybe_inject", "phase"):
+            name = _literal_metric(node)
+            if name is not None:
+                yield name, node.lineno
+        for kw in node.keywords:
+            if kw.arg == "seam" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                yield kw.value.value, node.lineno
+
+
+@register
+class ChaosSeamRegisteredRule(LibraryRule):
+    id = "chaos-seam-registered"
+    family = "contracts"
+    rationale = (
+        "chaos schedules are parsed against seam names as free-form "
+        "strings; a call site naming a seam absent from KNOWN_SEAMS "
+        "(supervisor/chaos.py) can never be targeted by a drill and a "
+        "typo there fails silently — the registry makes the seam "
+        "namespace closed and checkable"
+    )
+    hint = (
+        "add the seam to KNOWN_SEAMS in trlx_tpu/supervisor/chaos.py "
+        "(and give it a chaos drill test)"
+    )
+
+    def check(self, ctx, project):
+        if ctx.path == "trlx_tpu/supervisor/chaos.py":
+            return
+        known = project.known_seams()
+        for seam, line in _literal_seams(ctx):
+            if seam not in known:
+                yield self.finding(
+                    ctx, line,
+                    f"chaos seam '{seam}' is not registered in "
+                    f"KNOWN_SEAMS (supervisor/chaos.py)",
+                )
+
+
+@register
+class ChaosSeamTestedRule(Rule):
+    id = "chaos-seam-tested"
+    family = "contracts"
+    rationale = (
+        "a registered seam no test ever injects is a fault-handling "
+        "path that has never executed — the 'shipped dead' "
+        "checkpointing failure the reference survey documents "
+        "(SURVEY §3.6), which this repo's chaos drills exist to "
+        "prevent; every seam must appear in at least one test"
+    )
+    hint = (
+        "add a chaos drill (chaos.configure('<seam>:...')) exercising "
+        "the seam, or remove it from KNOWN_SEAMS"
+    )
+
+    def run(self, project):
+        corpus = project.tests_text()
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_library:
+                continue
+            seams = self._registry(ctx)
+            if seams is None:
+                continue
+            line, names = seams
+            for seam in names:
+                if seam not in corpus:
+                    yield self.finding(
+                        ctx, line,
+                        f"registered chaos seam '{seam}' is never "
+                        f"exercised by any test",
+                    )
+
+    def _registry(self,
+                  ctx: FileContext) -> Optional[Tuple[int, List[str]]]:
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_SEAMS":
+                    return node.lineno, _const_strings(node.value)
+        return None

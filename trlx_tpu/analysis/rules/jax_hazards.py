@@ -1,0 +1,353 @@
+"""JAX hazard rules: donation, host sync, and jit churn.
+
+These guard the invariants PR 11 measured (``compile/recompiles == 0``
+in steady state) and the ones XLA only punishes at runtime: a donated
+buffer is dead the moment the compiled call returns, and a host sync
+inside a traced function either fails under jit or silently serialises
+the device stream under ``aot_jit``'s warmed executables.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis import Rule, register
+from trlx_tpu.analysis.model import FileContext
+
+#: spellings of the jit entry points (module attr or bare import)
+_JIT_NAMES = ("jit", "aot_jit")
+
+#: attribute accesses that are static metadata, not device data
+_STATIC_ATTRS = ("shape", "dtype", "ndim", "size", "sharding")
+
+
+def _dotted(node) -> Optional[str]:
+    """``self.pool`` -> "self.pool", ``x`` -> "x"; None otherwise."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        if base is not None:
+            return f"{base}.{node.attr}"
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    """Last path component of the callee: ``jax.jit`` -> "jit"."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    return _call_name(node) in _JIT_NAMES
+
+
+def _int_tuple(expr) -> List[int]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _donated_positions(call: ast.Call) -> Set[int]:
+    """donate_argnums= positions; an IfExp (``(3, 4) if donate else ()``)
+    contributes the union of both branches."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        expr = kw.value
+        if isinstance(expr, ast.IfExp):
+            return set(_int_tuple(expr.body)) | set(_int_tuple(expr.orelse))
+        return set(_int_tuple(expr))
+    return set()
+
+
+def _scope_of(ctx: FileContext, node) -> ast.AST:
+    fn = ctx.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return fn if fn is not None else ctx.tree
+
+
+def _stmt_of(ctx: FileContext, node) -> Optional[ast.stmt]:
+    if isinstance(node, ast.stmt):
+        return node
+    for anc in ctx.parent_chain(node):
+        if isinstance(anc, ast.stmt):
+            return anc
+    return None
+
+
+@register
+class UseAfterDonateRule(Rule):
+    id = "use-after-donate"
+    family = "jax"
+    rationale = (
+        "donate_argnums hands the buffer to XLA: after the call the "
+        "array behind that name is deleted, and the next read raises "
+        "'buffer has been deleted' — but only on device, so CPU tests "
+        "pass while the TPU run dies mid-decode. slots.py donates the "
+        "KV pool and decode state on every step; the only safe shape "
+        "is rebinding the name from the call's own result"
+    )
+    hint = (
+        "rebind the donated name from the call result in the same "
+        "statement (x, st = fn(..., x, st)), or drop it from "
+        "donate_argnums"
+    )
+
+    def run(self, project):
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_library:
+                continue
+            yield from self._check_file(ctx)
+
+    def _check_file(self, ctx: FileContext):
+        # pass 1: donating wrappers bound to a name/attribute
+        donating: Dict[str, Set[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if not _is_jit_call(node.value):
+                continue
+            positions = _donated_positions(node.value)
+            if not positions:
+                continue
+            for t in node.targets:
+                name = _dotted(t)
+                if name:
+                    donating[name] = positions
+        if not donating:
+            return
+        # pass 2: call sites of those wrappers
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if callee not in donating:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # positions unknowable through *args
+            for pos in sorted(donating[callee]):
+                if pos >= len(node.args):
+                    continue
+                arg = _dotted(node.args[pos])
+                if arg is None:
+                    continue  # expression result: nothing to re-read
+                bad = self._read_after(ctx, node, arg)
+                if bad is not None:
+                    yield self.finding(
+                        ctx, bad,
+                        f"'{arg}' was donated to '{callee}' (arg {pos}) "
+                        f"on line {node.lineno} and is read again — the "
+                        f"buffer no longer exists after the call",
+                    )
+
+    def _read_after(self, ctx: FileContext, call: ast.Call,
+                    name: str) -> Optional[int]:
+        """Line of the first Load of ``name`` after the call statement
+        that is not preceded by a rebind; None when safe. Same-statement
+        rebinds (x, st = fn(..., x, st)) are the safe idiom: loads in
+        the args happen before the result is stored."""
+        stmt = _stmt_of(ctx, call)
+        if stmt is None:
+            return None
+        for node in ast.walk(stmt):
+            if _dotted(node) == name and isinstance(
+                getattr(node, "ctx", None), ast.Store
+            ):
+                return None  # the call's own statement rebinds the name
+        scope = _scope_of(ctx, call)
+        after: List[Tuple[int, int, bool]] = []  # (line, col, is_store)
+        for node in ast.walk(scope):
+            if _dotted(node) != name:
+                continue
+            if isinstance(ctx.parents.get(node), ast.Attribute):
+                continue  # part of a longer chain; matched at its root
+            if node.lineno <= (stmt.end_lineno or stmt.lineno):
+                continue
+            is_store = isinstance(
+                getattr(node, "ctx", None), (ast.Store, ast.Del)
+            )
+            after.append((node.lineno, node.col_offset, is_store))
+        for line, _col, is_store in sorted(after):
+            if is_store:
+                return None  # rebound before any read
+            return line
+        return None
+
+
+def _jitted_functions(ctx: FileContext) -> List[ast.FunctionDef]:
+    """Functions compiled by jit: decorated with jax.jit/aot_jit (bare
+    or partial(jax.jit, ...)), or passed by name to a jit call in the
+    same scope (scope-matched, so a public method sharing its name with
+    the inner device function it wraps is not misflagged)."""
+    out = []
+    jitted_names = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(
+                    (node.args[0].id, _scope_of(ctx, node))
+                )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        parent_scope = ctx.enclosing(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) or ctx.tree
+        if (node.name, parent_scope) in jitted_names:
+            out.append(node)
+            continue
+        for dec in node.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                if _call_name(dec) == "partial" and dec.args:
+                    target = dec.args[0]
+                else:
+                    target = dec.func
+            name = _dotted(target) or ""
+            if name.split(".")[-1] in _JIT_NAMES:
+                out.append(node)
+                break
+    return out
+
+
+def _params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _rooted_in(ctx: FileContext, expr, params: Set[str],
+               stop) -> bool:
+    """Does ``expr`` reach device data rooted at a traced parameter?
+    Paths through static metadata attrs (.shape/.dtype/...) don't
+    count — ``float(x.shape[0])`` is host-side and fine."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Name) or node.id not in params:
+            continue
+        static = False
+        cur = node
+        while cur is not stop:
+            parent = ctx.parents.get(cur)
+            if parent is None:
+                break
+            if isinstance(parent, ast.Attribute) and (
+                parent.attr in _STATIC_ATTRS
+            ):
+                static = True
+                break
+            cur = parent
+        if not static:
+            return True
+    return False
+
+
+@register
+class HostSyncInJitRule(Rule):
+    id = "host-sync-in-jit"
+    family = "jax"
+    rationale = (
+        "inside a traced function there are no values, only tracers: "
+        "float()/int()/.item()/np.asarray/jax.device_get on a traced "
+        "operand is a ConcretizationTypeError under jit, and where it "
+        "survives (shape metadata taken the wrong way, debug paths) it "
+        "forces a device->host sync that stalls the decode stream the "
+        "serve engine pipelines"
+    )
+    hint = (
+        "keep the math in jax.numpy; pull values to host only outside "
+        "the compiled function (shape/dtype metadata is fine as-is)"
+    )
+
+    def run(self, project):
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_library:
+                continue
+            for fn in _jitted_functions(ctx):
+                yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: FileContext, fn):
+        params = _params(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func) or ""
+            leaf = callee.split(".")[-1]
+            if leaf == "device_get" or callee == "jax.device_get":
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"jax.device_get inside jit-compiled '{fn.name}'",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                yield self.finding(
+                    ctx, node.lineno,
+                    f".item() inside jit-compiled '{fn.name}' — "
+                    f"host sync on a tracer",
+                )
+            elif leaf in ("float", "int", "asarray", "array") and (
+                callee in ("float", "int")
+                or callee.split(".")[0] in ("np", "numpy", "onp")
+            ):
+                if node.args and _rooted_in(
+                    ctx, node.args[0], params, stop=node
+                ):
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{callee}() on a traced value inside "
+                        f"jit-compiled '{fn.name}'",
+                    )
+
+
+@register
+class JitInLoopRule(Rule):
+    id = "jit-in-loop"
+    family = "jax"
+    rationale = (
+        "jax.jit/aot_jit inside a loop body builds a NEW wrapper (and "
+        "cache) per iteration, so every call retraces and recompiles — "
+        "exactly the steady-state recompile the serve mesh's "
+        "compile/recompiles == 0 invariant (PR 11) forbids"
+    )
+    hint = (
+        "hoist the jit()/aot_jit() call out of the loop and reuse the "
+        "returned callable"
+    )
+
+    def run(self, project):
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_library:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                    continue
+                loop = ctx.enclosing(node, (ast.For, ast.While))
+                if loop is None:
+                    continue
+                # a nested def re-jitting per *call* is a different
+                # story; only flag when the loop is in the same function
+                if _scope_of(ctx, node) is not _scope_of(ctx, loop):
+                    continue
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"{_call_name(node)}() constructed inside a loop "
+                    f"(line {loop.lineno}) — fresh executable cache "
+                    f"every iteration",
+                )

@@ -477,8 +477,6 @@ class MicroBatcher:
         under the engine dispatch lock — atomic w.r.t. in-flight decodes,
         zero recompiles. Returns the reload verdict dict; a failed probe
         rolls back by never installing."""
-        import threading as _threading
-
         import jax
         import numpy as np
 
@@ -509,8 +507,6 @@ class MicroBatcher:
             telemetry.inc("serve/reload_failures")
             return {"reloaded": False, "model_version": old_version,
                     "reason": detail}
-        if e._lock is None:
-            e._lock = _threading.Lock()
         with e._lock:  # no decode mid-dispatch sees a torn weight set
             e.install_views(views)
         e.commit_version(label or None)

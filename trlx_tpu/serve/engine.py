@@ -357,8 +357,13 @@ class InferenceEngine:
             min_new_tokens=0,
         )
         self.pad_token_id = pad
+        import threading
+
         self._decode_fns = {}  # bucket -> aot_jit'd generate closure
-        self._lock = None  # created lazily (threading import kept local)
+        # eager, not lazy: a first-use `if lock is None` check is itself
+        # a race — two first-callers each build a Lock and hold
+        # different ones (graftlint: lazy-lock)
+        self._lock = threading.Lock()
         self.warmed = False
 
     # -- construction --------------------------------------------------- #
@@ -773,8 +778,6 @@ class InferenceEngine:
         ``[B, P]`` int32; returns the GenerationOutput as host numpy
         (blocking — the micro-batcher's flush IS the dispatch boundary).
         """
-        import threading
-
         import jax
 
         from trlx_tpu import telemetry
@@ -785,8 +788,6 @@ class InferenceEngine:
                 f"decode batch shape {tokens.shape} does not match "
                 f"bucket (batch={B}, prompt={P})"
             )
-        if self._lock is None:
-            self._lock = threading.Lock()
         fn = self._decode_fn(bucket)
         rng = jax.random.PRNGKey(seed)
         with self._lock, telemetry.span(self.span_name(bucket)):

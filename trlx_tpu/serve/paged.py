@@ -38,9 +38,12 @@ evicted before their content lands. A failed prefill rolls the inserted
 nodes back (:meth:`RadixCache.rollback`).
 
 Everything here is nanosecond-scale dict/list work on the scheduler
-thread — no jax, no locks, no device syncs.
+thread — no jax, no device syncs. The allocator's free list and
+refcounts carry their own mutex (the reload/drain paths reach them from
+off-worker threads); the radix trie itself stays worker-confined.
 """
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from trlx_tpu import telemetry
@@ -53,10 +56,11 @@ class PageAllocator:
         if num_pages <= 0:
             raise ValueError(f"num_pages={num_pages} must be >= 1")
         self.num_pages = num_pages
+        self._lock = threading.Lock()
         # LIFO free list: recently-freed pages are reused first (their
         # HBM is warm, and reuse order is deterministic for tests)
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
-        self._ref: List[int] = [0] * num_pages
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))  # guarded-by: _lock
+        self._ref: List[int] = [0] * num_pages  # guarded-by: _lock
 
     def free_count(self) -> int:
         return len(self._free)
@@ -70,35 +74,40 @@ class PageAllocator:
         never partial, never raising)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
-            return None
-        pages = [self._free.pop() for _ in range(n)]
-        for p in pages:
-            self._ref[p] = 1
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._ref[p] = 1
         return pages
 
     def retain(self, page: int) -> None:
-        self._ref[page] += 1
+        with self._lock:
+            self._ref[page] += 1
 
     def release(self, page: int) -> int:
         """Drop one reference; returns the new refcount. A page at
         refcount 0 is NOT auto-freed — the radix cache may still own it
         (cached, evictable); :meth:`free_page` returns it to the list."""
-        ref = self._ref[page] - 1
-        if ref < 0:
-            raise RuntimeError(
-                f"page {page} released below refcount 0 — allocator "
-                f"bookkeeping bug (double free)"
-            )
-        self._ref[page] = ref
+        with self._lock:
+            ref = self._ref[page] - 1
+            if ref < 0:
+                raise RuntimeError(
+                    f"page {page} released below refcount 0 — allocator "
+                    f"bookkeeping bug (double free)"
+                )
+            self._ref[page] = ref
         return ref
 
     def free_page(self, page: int) -> None:
-        if self._ref[page] != 0:
-            raise RuntimeError(
-                f"page {page} freed at refcount {self._ref[page]} (> 0)"
-            )
-        self._free.append(page)
+        with self._lock:
+            if self._ref[page] != 0:
+                raise RuntimeError(
+                    f"page {page} freed at refcount {self._ref[page]} "
+                    f"(> 0)"
+                )
+            self._free.append(page)
 
 
 class _Node:

@@ -327,16 +327,16 @@ class InferenceServer:
             # a watchdog stall dumps the engine-step ring next to the
             # all-thread stack dump (trlx_tpu.serve.trace.FlightRecorder)
             sup.add_dump_fn(dump_fn)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._http_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None  # guarded-by: _stop_lock
+        self._http_thread: Optional[threading.Thread] = None  # guarded-by: _stop_lock
         self._stop_lock = threading.Lock()
         # -- crash-only lifecycle (docs "Fault tolerance") -------------- #
         self._lifecycle_lock = threading.Lock()
-        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_thread: Optional[threading.Thread] = None  # guarded-by: _lifecycle_lock
         self._drain_done = threading.Event()
         self._drain_clean = False
         self._watch_stop = threading.Event()
-        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None  # guarded-by: _stop_lock
         self._watch_last_tried: Optional[str] = None
 
     @property
@@ -556,19 +556,27 @@ class InferenceServer:
                       "nothing to watch", file=sys.stderr, flush=True)
             else:
                 self._watch_stop.clear()
-                self._watch_thread = threading.Thread(
+                watch = threading.Thread(
                     target=self._watch_loop, name="trlx-serve-watch",
                     daemon=True,
                 )
-                self._watch_thread.start()
+                # publish under the same lock stop() swaps under — a
+                # drain-thread stop() racing start() must see either
+                # None or a joinable thread, never a torn handle
+                with self._stop_lock:
+                    self._watch_thread = watch
+                watch.start()
         handler = type("Handler", (_Handler,), {"server_ref": self})
-        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
-        self.port = self._httpd.server_address[1]  # resolve port=0
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="trlx-serve-http",
+        httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = httpd.server_address[1]  # resolve port=0
+        http_thread = threading.Thread(
+            target=httpd.serve_forever, name="trlx-serve-http",
             daemon=True,
         )
-        self._http_thread.start()
+        with self._stop_lock:
+            self._httpd = httpd
+            self._http_thread = http_thread
+        http_thread.start()
         print(f"[trlx_tpu.serve] listening on http://{self.host}:"
               f"{self.port} (buckets {[list(b) for b in self.engine.buckets]})",
               file=sys.stderr, flush=True)

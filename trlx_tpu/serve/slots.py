@@ -431,7 +431,7 @@ class SlotScheduler:
             )
         self._prompt_tokens_total = 0  # prefix hit-rate denominators
         self._prefix_tokens_saved = 0
-        self._queue = deque()
+        self._queue = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -455,11 +455,11 @@ class SlotScheduler:
         self._fr_admitted = 0
         self._fr_evicted = 0
         # -- crash-only lifecycle state (docs "Fault tolerance") -------- #
-        self._draining = False
+        self._draining = False  # guarded-by: _cond
         self._drain_deadline = 0.0
         self._drained = threading.Event()
         #: worker-applied hot-swap: {"params", "label", "done", "result"}
-        self._pending_swap: Optional[Dict] = None
+        self._pending_swap: Optional[Dict] = None  # guarded-by: _cond
         self._last_step_ms = 0.0
         self._replayed_requests = 0  # lifetime; /debug/state + bench
 
@@ -1177,7 +1177,11 @@ class SlotScheduler:
                 "reloaded": True, "model_version": version,
                 "previous_version": old_version,
             }
-        self._pending_swap = None
+        # the box is consumed; clear under the cond so a request_swap
+        # racing this publish sees either the old pending box or None,
+        # never a torn in-between
+        with self._cond:
+            self._pending_swap = None
         box["done"].set()
 
     def _probe_swap(self) -> None:

@@ -171,6 +171,7 @@ class RequestTrace:
                 "serve/decode_time", max(self.harvested - self.prefill_end,
                                          0.0)
             )
+        # lint: disable=metric-dynamic-name -- path is the scheduler kind, a closed 2-value enum (slots/static); both expansions are in the observability.rst catalog
         telemetry.observe(
             f"serve/request_latency_{path}", self.harvested - self.enqueued
         )

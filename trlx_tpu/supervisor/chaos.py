@@ -71,6 +71,32 @@ from typing import List, Optional, Tuple
 
 ENV_VAR = "TRLX_TPU_CHAOS"
 
+#: the closed seam namespace. Every injection point in the library —
+#: ``maybe_inject(<seam>)``, ``retry_call(seam=...)``, and the
+#: supervised phase names chaos fires on — must appear here, and every
+#: entry must be exercised by at least one test; graftlint
+#: (chaos-seam-registered / chaos-seam-tested) enforces both ways, so a
+#: typo'd seam in a schedule or a drill that can never fire is a lint
+#: failure, not a silent no-op. Keep the docstring's seam tour in sync.
+KNOWN_SEAMS = (
+    # retry_call seams (fired per attempt, inside the bounded worker)
+    "reward_fn",
+    "tracker",
+    # training phase seams (fired once at phase entry)
+    "rollout",
+    "ppo_update",
+    "ilql_update",
+    "eval",
+    "checkpoint_save",
+    # serving seams (see the module docstring for where each lands)
+    "serve_admit",
+    "serve_prefix_match",
+    "serve_decode",
+    "serve_request",
+    "serve_replay",
+    "serve_reload",
+)
+
 _ACTIONS = ("hang", "exc", "slow", "sigterm")
 
 _RULE_RE = re.compile(

@@ -67,12 +67,18 @@ _PREDECLARED_COUNTERS = (
     "fault/stall_escalations",
     "fault/seam_timeouts",
     "fault/walltime_exits",
+    "fault/checkpoint_debris_cleared",
     "checkpoint/saves",
     "checkpoint/restores",
     # steady-state executable-cache misses after warmup
     # (trlx_tpu.utils.aotjit): a sharding/layout drift that recompiles
     # every step shows up as a counter climbing with iter, not silence
     "compile/recompiles",
+    # chaos drills fired (supervisor.chaos) and span-ring overflow
+    # (tracer) — both are "the instrumentation itself acted" signals
+    # that must read 0, not absent, on a healthy run
+    "chaos/injections",
+    "telemetry/trace_events_dropped",
 )
 
 
@@ -83,8 +89,7 @@ class TelemetrySession:
         self.run_dir = run_dir
         self.force_dir = force_dir
         self.headline: Optional[Dict[str, Any]] = None
-        for name in _PREDECLARED_COUNTERS:
-            self.registry.counters.setdefault(name, 0.0)
+        self.registry.predeclare(_PREDECLARED_COUNTERS)
 
     # -- per-iteration ---------------------------------------------------- #
 
@@ -211,8 +216,7 @@ def predeclare(names) -> None:
     zeros instead of missing keys — without polluting every training
     run's emission with counters that can never fire there."""
     if _session is not None:
-        for name in names:
-            _session.registry.counters.setdefault(name, 0.0)
+        _session.registry.predeclare(names)
 
 
 def set_gauge(name: str, value: float) -> None:

@@ -17,14 +17,19 @@ single accumulation point every subsystem reports into:
 
 Everything is plain-python dict/deque work — no jax imports, no host
 syncs — so updating a metric costs nanoseconds and is safe from any hot
-path (including signal handlers). Two export shapes:
+path. Updates take a reentrant lock: the registry is written from the
+scheduler worker, HTTP handler threads, drain/watch threads AND signal
+handlers (PreemptionGuard incs ``fault/preempt_sigterm`` from SIGTERM on
+the main thread, possibly interrupting that thread's own ``inc`` —
+hence RLock, a plain Lock would self-deadlock). Two export shapes:
 ``tracker_stats()`` is the flat float dict the existing tracker protocol
 carries per iteration; ``summary()`` is the structured run-level record
 ``telemetry.json`` persists.
 """
 
+import threading
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 
 class TimingHist:
@@ -82,25 +87,40 @@ class TimingHist:
 
 class MetricsRegistry:
     def __init__(self):
-        self.counters: Dict[str, float] = {}
-        self.gauges: Dict[str, float] = {}
-        self.hists: Dict[str, TimingHist] = {}
+        # RLock, not Lock: see the module docstring — inc() runs inside
+        # signal handlers that can interrupt the main thread mid-inc
+        self._lock = threading.RLock()
+        self.counters: Dict[str, float] = {}  # guarded-by: _lock
+        self.gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self.hists: Dict[str, TimingHist] = {}  # guarded-by: _lock
 
     # -- updates -------------------------------------------------------- #
 
     def inc(self, name: str, n: float = 1.0) -> float:
-        value = self.counters.get(name, 0.0) + n
-        self.counters[name] = value
+        with self._lock:
+            value = self.counters.get(name, 0.0) + n
+            self.counters[name] = value
         return value
 
     def set_gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = float(value)
+        with self._lock:
+            self.gauges[name] = float(value)
 
     def observe(self, name: str, seconds: float) -> None:
-        hist = self.hists.get(name)
-        if hist is None:
-            hist = self.hists[name] = TimingHist()
-        hist.observe(seconds)
+        with self._lock:
+            hist = self.hists.get(name)
+            if hist is None:
+                hist = self.hists[name] = TimingHist()
+            hist.observe(seconds)
+
+    def predeclare(self, names: Iterable[str]) -> None:
+        """Register counters at 0 without bumping existing values — the
+        one sanctioned way a name enters the registry before its first
+        event (graftlint's metric-predeclared rule audits call sites
+        against these tuples)."""
+        with self._lock:
+            for name in names:
+                self.counters.setdefault(name, 0.0)
 
     # -- exports -------------------------------------------------------- #
 
@@ -109,15 +129,17 @@ class MetricsRegistry:
         and gauges report their current value; histograms report the LAST
         duration (the per-iteration ``time/<phase>`` breakdown — run-level
         quantiles belong to summary(), not the metrics stream)."""
-        out = dict(self.counters)
-        out.update(self.gauges)
-        for name, hist in self.hists.items():
-            out[name] = hist.last
+        with self._lock:
+            out = dict(self.counters)
+            out.update(self.gauges)
+            for name, hist in self.hists.items():
+                out[name] = hist.last
         return out
 
     def summary(self) -> Dict[str, object]:
-        return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "timings": {n: h.stats() for n, h in self.hists.items()},
-        }
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timings": {n: h.stats() for n, h in self.hists.items()},
+            }
