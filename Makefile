@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -96,6 +96,21 @@ serve-mesh:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python -m pytest tests/test_serve_mesh.py -q -m mesh
+
+# fleet-router tier (trlx_tpu/router, docs "Fleet routing"): the
+# stdlib-only front-end that spreads /generate over N engine replicas —
+# prefix-affinity placement (block math bit-identical to serve/paged.py,
+# greedy-parity asserted per routed response), health-driven membership
+# with zero-loss failover onto a second replica, router-side rolling
+# checkpoint upgrades (fence -> quiesce -> /admin/reload -> smoke ->
+# re-admit, fleet never below N-1 admitting, cross-version parity), the
+# 503-not-a-hang empty-fleet path, X-Hop-Count forwarding/508 cap, the
+# router/* metric family on the router's own /metrics, and chaos drills
+# on the router_route / router_probe / router_rollout seams. Part of
+# the non-slow tier-1 set; this target runs just them.
+router:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_router.py \
+		-q -m 'not slow'
 
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \

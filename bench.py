@@ -1440,6 +1440,251 @@ def bench_serving(n_requests=96, trace_seed=17):
     }
 
 
+def bench_fleet(n_requests=96, trace_seed=17, config=None):
+    """Fleet leg: the shared-prefix burst through the prefix-affinity
+    router (trlx_tpu.router) over 2 in-process replicas, vs 1 engine
+    direct — the cache-aware-routing A/B the disaggregated-serving
+    literature scores as goodput at a fixed SLO rather than raw tok/s.
+
+    The direct leg replays the trace against one SlotScheduler and its
+    TTFT p95 becomes the fleet SLO. The fleet leg replays the SAME
+    trace over HTTP through the router (16-way client concurrency, so
+    affinity has an order to exploit), and MID-TRACE drives a rolling
+    checkpoint upgrade (`POST /admin/rollout`) across both replicas —
+    zero lost requests and zero steady-state recompiles are asserted,
+    not reported. Reported: ``fleet_goodput`` (fraction of routed
+    requests whose TTFT beat the SLO), ``fleet_affinity_hit_rate``,
+    ``fleet_tokens_per_sec`` (wall-clock, rollout window included) and
+    its ratio to the direct leg."""
+    import json as _json
+    import queue
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.router import FleetRouter, RouterConfig
+    from trlx_tpu.serve import InferenceEngine, InferenceServer, ServeConfig
+    from trlx_tpu.serve.slots import SlotScheduler
+    from trlx_tpu.utils.loading import get_model
+
+    if config is None:
+        config = TRLConfig.from_dict({
+            "model": {
+                "model_path": "from-config", "tokenizer_path": "byte",
+                "model_type": "JaxPPOTrainer", "num_layers_unfrozen": 2,
+                "model_spec": {"vocab_size": 50257, "n_layer": 12,
+                               "n_head": 12, "d_model": 768,
+                               "n_positions": 1024},
+                "compute_dtype": "bfloat16",
+            },
+            "train": {
+                "n_ctx": 64, "epochs": 1, "total_steps": 4,
+                "batch_size": 8, "grad_clip": 1.0, "lr_ramp_steps": 0,
+                "lr_decay_steps": 4, "weight_decay": 1e-6,
+                "learning_rate_init": 1e-3, "learning_rate_target": 1e-3,
+                "log_interval": 10**9, "checkpoint_interval": 10**9,
+                "eval_interval": 10**9, "pipeline": "PPOPipeline",
+                "orchestrator": "PPOOrchestrator", "input_size": 4,
+                "gen_size": 48, "seed": 0, "telemetry": False,
+            },
+            "method": {
+                "name": "ppoconfig", "num_rollouts": 8, "chunk_size": 8,
+                "ppo_epochs": 1,
+                "gen_kwargs": {"max_length": 48, "min_length": 48,
+                               "top_k": 0, "top_p": 1.0,
+                               "do_sample": True},
+            },
+        })
+    geometry = config.model.model_spec
+    page_size = 16
+    serve_kwargs = dict(
+        buckets=[[8, 64, 32]], max_wait_ms=8.0,
+        max_queue=max(256, n_requests), scheduler="slots", slots=16,
+        kv_layout="paged", page_size=page_size,
+    )
+
+    # the rollout needs a checkpoint on disk; both replicas (and the
+    # direct engine) serve the same committed step_1
+    run_dir = tempfile.mkdtemp(prefix="bench_fleet_")
+    trainer = get_model(config.model.model_type)(config)
+    trainer.save(os.path.join(run_dir, "step_1"))
+    del trainer
+    _reclaim_device_memory()
+
+    rng = np.random.default_rng(trace_seed)
+    system_prompts = [
+        [int(t) for t in rng.integers(1, 250, size=48)] for _ in range(4)
+    ]
+    trace = [
+        (
+            system_prompts[i % 4]
+            + [int(t) for t in rng.integers(1, 250,
+                                            size=rng.integers(2, 9))],
+            int(rng.choice([4, 8, 16])),
+        )
+        for i in range(n_requests)
+    ]
+
+    def pct_ms(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)] * 1e3
+
+    # ---- direct leg: one engine, one SlotScheduler, no HTTP ----------
+    telemetry.start()
+    direct_engine = InferenceEngine.from_checkpoint(
+        os.path.join(run_dir, "step_1"),
+        serve=ServeConfig(**serve_kwargs),
+    )
+    sched = SlotScheduler(direct_engine)
+    sched.warmup()
+    sched.start()
+    try:
+        t0 = time.perf_counter()
+        reqs = [sched.submit(t, max_new_tokens=mn) for t, mn in trace]
+        for r in reqs:
+            r.wait(timeout=600.0)
+        direct_dt = time.perf_counter() - t0
+        direct_tok_s = sum(len(r.result) for r in reqs) / direct_dt
+        direct_ttfts = [r.trace.ttft() for r in reqs
+                        if r.trace is not None and r.trace.first_token]
+    finally:
+        sched.stop()
+    slo_ttft_ms = max(pct_ms(direct_ttfts, 0.95), 1.0)
+    log(f"fleet[direct]:     {direct_tok_s:,.1f} useful tok/s on 1 "
+        f"engine; TTFT p95 {slo_ttft_ms:.0f} ms becomes the fleet SLO")
+    del direct_engine, sched, reqs
+    _reclaim_device_memory()
+
+    # ---- fleet leg: 2 replicas behind the router, rollout mid-trace --
+    telemetry.start()
+    servers = [
+        InferenceServer(
+            InferenceEngine.from_checkpoint(
+                os.path.join(run_dir, "step_1"),
+                serve=ServeConfig(**serve_kwargs),
+            ),
+            port=0,
+        ).start(warmup=True)
+        for _ in range(2)
+    ]
+    router = FleetRouter(RouterConfig(
+        backends=[f"127.0.0.1:{s.port}" for s in servers],
+        port=0, page_size=page_size, probe_interval=0.2,
+        failover_retries=1, slo_ttft_ms=slo_ttft_ms,
+        rollout_timeout=600.0, request_timeout=600.0,
+    )).start()
+
+    results = [None] * len(trace)
+    work = queue.Queue()
+    for i, item in enumerate(trace):
+        work.put((i, item))
+    completed = [0]
+    completed_lock = threading.Lock()
+
+    def client():
+        while True:
+            try:
+                i, (tokens, mn) = work.get_nowait()
+            except queue.Empty:
+                return
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/generate",
+                data=_json.dumps({
+                    "tokens": tokens, "max_new_tokens": mn,
+                    "trace": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    results[i] = (resp.status,
+                                  _json.loads(resp.read()))
+            except urllib.error.HTTPError as e:
+                results[i] = (e.code, _json.loads(e.read() or b"{}"))
+            with completed_lock:
+                completed[0] += 1
+
+    t0 = time.perf_counter()
+    workers = [threading.Thread(target=client) for _ in range(16)]
+    for w in workers:
+        w.start()
+    # mid-trace rolling upgrade: wait for the first quarter to land so
+    # the system prompts are committed, then walk the fleet
+    while completed[0] < max(n_requests // 4, 1):
+        time.sleep(0.01)
+    t_roll = time.perf_counter()
+    rollout = router.rollout(os.path.join(run_dir, "step_1"))
+    rollout_window_s = time.perf_counter() - t_roll
+    for w in workers:
+        w.join(timeout=900.0)
+    fleet_dt = time.perf_counter() - t0
+
+    if not rollout.get("ok"):
+        raise RuntimeError(f"mid-trace rollout failed: {rollout}")
+    lost = [i for i, r in enumerate(results)
+            if r is None or r[0] != 200]
+    if lost:
+        raise RuntimeError(
+            f"fleet leg lost {len(lost)} requests: "
+            f"{[results[i] for i in lost[:3]]}"
+        )
+    registry = telemetry.current().registry
+    recompiles = int(registry.counters.get("compile/recompiles", 0.0))
+    if recompiles:
+        raise RuntimeError(
+            f"fleet leg recompiled {recompiles}x in steady state"
+        )
+    fleet_tok_s = sum(
+        len(r[1]["tokens"]) for r in results
+    ) / fleet_dt
+    ttfts_ms = [r[1]["trace"]["ttft_ms"] for r in results
+                if r[1].get("trace", {}).get("ttft_ms")]
+    goodput = (sum(1 for t in ttfts_ms if t <= slo_ttft_ms)
+               / max(len(ttfts_ms), 1))
+    hit_rate = registry.gauges.get("router/affinity_hit_rate", 0.0)
+    failovers = int(registry.counters.get("router/failovers", 0.0))
+    versions = {int(s["model_version"]) for s in rollout["steps"]}
+    router.stop()
+    for s in servers:
+        s.stop()
+    telemetry.start()
+    _reclaim_device_memory()
+
+    log(f"fleet[router]:     {fleet_tok_s:,.1f} useful tok/s over 2 "
+        f"replicas ({fleet_tok_s / max(direct_tok_s, 1e-9):.2f}x "
+        f"direct), goodput {goodput:.2f} at TTFT<={slo_ttft_ms:.0f} ms, "
+        f"affinity hit rate {hit_rate:.2f}, rolling upgrade -> "
+        f"model_version {sorted(versions)} in {rollout_window_s:.1f}s "
+        f"mid-trace, {failovers} failovers, 0 lost, 0 recompiles")
+
+    return {
+        "fleet_goodput": round(goodput, 3),
+        "fleet_slo_ttft_ms": round(slo_ttft_ms, 1),
+        "fleet_tokens_per_sec": round(fleet_tok_s, 1),
+        "fleet_vs_direct": round(
+            fleet_tok_s / max(direct_tok_s, 1e-9), 3
+        ),
+        "fleet_direct_tokens_per_sec": round(direct_tok_s, 1),
+        "router_affinity_hit_rate": round(hit_rate, 3),
+        "fleet_rollout_window_s": round(rollout_window_s, 2),
+        "fleet_failovers": failovers,
+        "fleet_workload": (
+            f"{n_requests}-request shared-prefix burst (4 48-token "
+            f"system prompts + 2..8-token tails, page_size=16) through "
+            f"the prefix-affinity router over 2 in-process replicas "
+            f"with a rolling checkpoint upgrade mid-trace; SLO = the "
+            f"direct single-engine leg's TTFT p95; zero lost requests "
+            f"and zero recompiles are asserted, not reported"
+        ),
+    }
+
+
 def _reclaim_device_memory():
     """Drop dead leg-local trainers' device buffers before the next leg.
 
@@ -1530,6 +1775,15 @@ def main():
         serving = {}
     _reclaim_device_memory()
     log(f"[leg] serving: {time.perf_counter() - t_leg:.0f}s")
+
+    # ---- fleet: shared-prefix burst through the prefix-affinity router ---
+    t_leg = time.perf_counter()
+    try:
+        serving.update(bench_fleet())
+    except Exception as e:  # must not sink the headline metric
+        log(f"fleet bench skipped: {e!r}")
+    _reclaim_device_memory()
+    log(f"[leg] fleet: {time.perf_counter() - t_leg:.0f}s")
 
     # ---- long-context train step (fused Pallas attention path) -----------
     t_leg = time.perf_counter()

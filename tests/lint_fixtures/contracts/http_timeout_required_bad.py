@@ -1,0 +1,16 @@
+import http.client
+import urllib.request
+
+
+def probe(url):
+    # no timeout=: blocks forever on a hung peer
+    with urllib.request.urlopen(url) as resp:
+        return resp.read()
+
+
+def connect(host):
+    return http.client.HTTPConnection(host)
+
+
+def connect_tls(host):
+    return http.client.HTTPSConnection(host, 443)

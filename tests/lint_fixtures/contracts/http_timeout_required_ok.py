@@ -1,0 +1,19 @@
+import http.client
+import urllib.request
+
+PROBE_TIMEOUT_S = 5.0
+
+
+def probe(url):
+    with urllib.request.urlopen(url, timeout=PROBE_TIMEOUT_S) as resp:
+        return resp.read()
+
+
+def connect(host):
+    return http.client.HTTPConnection(host, timeout=PROBE_TIMEOUT_S)
+
+
+def connect_tls(host):
+    return http.client.HTTPSConnection(
+        host, 443, timeout=PROBE_TIMEOUT_S
+    )

@@ -58,6 +58,90 @@ def test_retry_call_exhausts_and_reraises():
         retry_call(broken, retries=2, backoff=0.0)
 
 
+class _Backpressure(RuntimeError):
+    """Carries a server-provided pacing hint, like the router's 429."""
+
+    def __init__(self, retry_after_s):
+        super().__init__("backpressure")
+        self.retry_after_s = retry_after_s
+
+
+def test_retry_call_honors_retry_after_hint(monkeypatch):
+    """A server-provided Retry-After IS the delay — no jitter applied."""
+    import trlx_tpu.utils.faults as faults
+
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise _Backpressure(retry_after_s=1.5)
+        return "ok"
+
+    result = retry_call(
+        flaky, retries=2, backoff=0.5, log=lambda s: None,
+        retry_after_s=lambda e: getattr(e, "retry_after_s", None),
+    )
+    assert result == "ok"
+    assert slept == [1.5, 1.5]  # exactly the hint, both attempts
+
+
+def test_retry_call_hint_declined_falls_back_to_jitter(monkeypatch):
+    """Attempts whose exception declines the hint (returns None) keep
+    the decorrelated-jitter schedule: delay within [backoff, cap]."""
+    import trlx_tpu.utils.faults as faults
+
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    calls = {"n": 0}
+    backoff, retries = 0.25, 3
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _Backpressure(retry_after_s=2.0)  # hinted attempt
+        if calls["n"] <= 3:
+            raise RuntimeError("transient")  # hintless attempts
+        return "ok"
+
+    result = retry_call(
+        flaky, retries=retries, backoff=backoff, log=lambda s: None,
+        retry_after_s=lambda e: getattr(e, "retry_after_s", None),
+    )
+    assert result == "ok"
+    assert slept[0] == 2.0
+    cap = backoff * 2 ** retries
+    for delay in slept[1:]:
+        assert backoff <= delay <= cap
+
+
+def test_retry_call_float_hint_and_zero(monkeypatch):
+    """A plain float hint paces every retry; 0 means retry NOW (still a
+    valid server instruction, distinct from None = no hint)."""
+    import trlx_tpu.utils.faults as faults
+
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 1:
+            raise RuntimeError("transient")
+        return calls["n"]
+
+    assert retry_call(flaky, retries=1, backoff=0.5, log=lambda s: None,
+                      retry_after_s=0.75) == 2
+    assert slept == [0.75]
+    calls["n"] = 0
+    slept.clear()
+    assert retry_call(flaky, retries=1, backoff=0.5, log=lambda s: None,
+                      retry_after_s=0.0) == 2
+    assert slept == []  # delay 0 skips the sleep entirely
+
+
 # --------------------------------------------------------------------- #
 # StepGuard (unit)
 # --------------------------------------------------------------------- #

@@ -57,6 +57,7 @@ SIMPLE = [
     ("guarded-by", "locks/guarded_by", LIB),
     ("guarded-by-unknown", "locks/guarded_by_unknown", LIB),
     ("metric-dynamic-name", "contracts/metric_dynamic_name", LIB),
+    ("http-timeout-required", "contracts/http_timeout_required", LIB),
 ]
 
 

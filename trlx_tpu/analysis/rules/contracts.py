@@ -17,10 +17,10 @@ from trlx_tpu.analysis.model import FileContext, _const_strings
 
 #: counter namespaces under the predeclaration contract
 _COUNTER_PREFIXES = ("serve/", "fault/", "checkpoint/", "chaos/",
-                     "telemetry/", "compile/")
+                     "telemetry/", "compile/", "router/")
 
 #: namespaces the observability.rst catalog must cover
-_DOC_PREFIXES = ("serve/", "fault/")
+_DOC_PREFIXES = ("serve/", "fault/", "router/")
 
 _EMITTERS = ("inc", "set_gauge", "observe")
 
@@ -160,6 +160,48 @@ class MetricDynamicNameRule(LibraryRule):
                     f"dynamic metric name f\"{head.value}...\" — names "
                     f"in serve//fault/ must be static literals",
                 )
+
+
+#: outbound-HTTP constructors/calls that accept (and must be passed) an
+#: explicit timeout keyword — urllib.request.urlopen and the http.client
+#: connection classes both default to socket._GLOBAL_DEFAULT_TIMEOUT,
+#: i.e. block forever
+_HTTP_CALLEES = ("urlopen", "HTTPConnection", "HTTPSConnection")
+
+
+@register
+class HttpTimeoutRequiredRule(LibraryRule):
+    id = "http-timeout-required"
+    family = "contracts"
+    rationale = (
+        "the fleet router is an HTTP *client* inside the serving path: "
+        "urllib/http.client default to no socket timeout, so one hung "
+        "backend turns a missing timeout= into a silently wedged router "
+        "thread — a fleet-wide stall with no exception, no watchdog "
+        "attribution, and no retry; every outbound call under trlx_tpu/ "
+        "must bound its wait explicitly"
+    )
+    hint = (
+        "pass timeout=<seconds> explicitly (wire it to a config knob "
+        "like router.probe_timeout / router.request_timeout, not a "
+        "magic number)"
+    )
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _callee_leaf(node)
+            if leaf not in _HTTP_CALLEES:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            yield self.finding(
+                ctx, node.lineno,
+                f"outbound HTTP call '{leaf}(...)' without an explicit "
+                f"timeout= — defaults to blocking forever on a hung "
+                f"peer",
+            )
 
 
 def _literal_seams(ctx: FileContext) -> Iterable[Tuple[str, int]]:

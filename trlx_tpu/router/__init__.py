@@ -1,0 +1,893 @@
+"""Prefix-affinity fleet router: N engine replicas behind one endpoint.
+
+One :class:`InferenceServer` (trlx_tpu.serve) is a replica; the ROADMAP
+north-star is a fleet of them, and PRs 8/10/11 built exactly the
+primitives a fleet needs — ``/readyz`` vs ``/healthz``, graceful drain
+with ``Retry-After``, live hot-swap with ``serve/model_version``,
+per-request trace metadata including ``prefix_blocks_hit``. This module
+composes them into a stdlib-only front-end process
+(``python -m trlx_tpu.router --backends host:port,host:port``) that
+spreads ``POST /generate`` over the replicas and makes the fleet
+operable as one unit. Four pieces:
+
+- **Prefix-affinity routing** (:class:`AffinityIndex`). SGLang-style
+  radix caching (trlx_tpu.serve.paged) only pays off fleet-wide when
+  requests sharing a prefix land on the replica whose cache already
+  holds it — the cache-aware-routing result the disaggregated-serving
+  literature (DistServe, Splitwise) scores as goodput at a fixed SLO.
+  The router keeps a host-side index over recently routed prompt blocks
+  at ``page_size``-token granularity, mirroring the paged pool's block
+  math (``(len - 1) // page_size`` committed blocks — the cache can
+  never serve the final partial block), and routes each request to the
+  replica with the longest committed-prefix match, falling back to
+  least-loaded by probed queue depth. The engine's own ``"trace": true``
+  payload (``prefix_blocks_hit``) is the feedback signal: a replica
+  reporting fewer hits than the index predicted has evicted those pages,
+  and the stale entries are decayed on the spot.
+- **Health-driven membership + failover.** A prober thread walks each
+  backend's ``/readyz`` (admission) and ``/debug/state`` (queue depth,
+  degraded flag, model version) every ``probe_interval``; a non-ready or
+  unreachable replica is ejected from admission and re-admitted on
+  recovery. Idempotent-safe failures — connection errors, 429
+  (queue-full admission control), 503 (service-level shed) — retry on a
+  DIFFERENT replica through :func:`trlx_tpu.utils.faults.retry_call`,
+  honoring a server-provided ``Retry-After`` via its ``retry_after_s``
+  hint instead of pure jitter. Every hop stamps ``X-Request-Id`` through
+  unchanged (one trace id joins router and engine logs) and increments
+  ``X-Hop-Count`` (the engine rejects past ``MAX_HOPS`` with a typed
+  508, so a router misconfigured to point at itself cannot loop).
+- **Rolling checkpoint upgrades** (``POST /admin/rollout``). One replica
+  at a time: fence it from routing (the engine's own ``/admin/drain`` is
+  process-terminal by crash-only design, so the router drains at the
+  ROUTING layer — stop sending, wait for its in-flight work to finish),
+  ``POST /admin/reload`` the new checkpoint, poll ``/readyz`` until the
+  smoke-probed swap reports the new ``model_version``, re-admit. A
+  failed probe (``serve/reload_failures`` engine-side) re-admits the
+  replica on its OLD weights and aborts the rollout — the fleet never
+  drops below N-1 admitting replicas, and ``router/fleet_model_version``
+  converges to the new version on success.
+- **Fleet observability + degradation-aware admission.** A ``router/*``
+  metric family (predeclared, docs "Observability") on the router's own
+  ``/metrics`` — JSON summary or Prometheus text exposition via the same
+  content negotiation as the engines — plus a fleet ``/healthz`` with
+  per-backend state. A backend advertising the degraded-mode signal
+  (``serve.degrade_step_ms``) has its share halved in the least-loaded
+  fallback (its effective queue depth doubles), so a sick replica sheds
+  load before it stalls.
+
+The router is host-side stdlib only — ``ThreadingHTTPServer`` in front,
+``urllib.request`` toward the backends (every outbound call carries an
+explicit timeout; graftlint ``http-timeout-required`` enforces it), no
+JAX anywhere — and runs under the supervisor watchdog with its own
+chaos seams (``router_route`` / ``router_probe`` / ``router_rollout``,
+KNOWN_SEAMS). All timing is ``trlx_tpu.supervisor.monotonic``.
+"""
+
+import contextlib
+import json
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from trlx_tpu import supervisor, telemetry
+from trlx_tpu.serve.trace import new_trace_id
+from trlx_tpu.supervisor import RunSupervisor, chaos, monotonic
+from trlx_tpu.utils.faults import retry_call
+
+#: the router/* counter family, predeclared at start() so a scrape sees
+#: zeros, not gaps (graftlint metric-predeclared; docs "Observability")
+_ROUTER_COUNTERS = (
+    "router/requests",
+    "router/responses",
+    "router/request_errors",
+    "router/affinity_hits",
+    "router/affinity_misses",
+    "router/affinity_decays",
+    "router/failovers",
+    "router/ejections",
+    "router/readmissions",
+    "router/rollouts",
+    "router/rollout_steps",
+    "router/rollout_aborts",
+)
+
+
+class NoBackendAvailable(RuntimeError):
+    """Every replica is ejected, rolling, or already tried — the fleet
+    cannot admit this request (HTTP 503 at the router's edge)."""
+
+
+class _UpstreamRetryable(RuntimeError):
+    """A backend answered 429/503 (idempotent-safe service-level
+    failure) or was unreachable; carries the server-provided pacing so
+    retry_call's ``retry_after_s`` hint can honor it."""
+
+    def __init__(self, message: str, status: int = 0,
+                 retry_after_s: Optional[float] = None,
+                 payload: Optional[dict] = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+        self.payload = payload or {"error": message}
+
+
+@dataclass
+class RouterConfig:
+    """Fleet-router knobs (the ``router:`` YAML section; CLI flags win).
+
+    ``page_size`` must match the backends' ``serve.page_size`` — it is
+    the affinity index's block granularity, and a mismatch silently
+    degrades routing to least-loaded (the index still works, its block
+    boundaries just stop lining up with the replicas' radix caches).
+    """
+
+    backends: List[str] = field(default_factory=list)
+    host: str = "127.0.0.1"
+    port: int = 8090
+    #: affinity-block granularity in tokens (mirror serve.page_size)
+    page_size: int = 64
+    #: LRU cap on affinity prefix entries (block-chain prefixes)
+    affinity_entries: int = 4096
+    #: health-prober sweep period / per-probe HTTP timeout (seconds)
+    probe_interval: float = 0.5
+    probe_timeout: float = 5.0
+    #: per-forward HTTP timeout toward a backend (seconds)
+    request_timeout: float = 120.0
+    #: extra replicas tried after an idempotent-safe failure
+    failover_retries: int = 1
+    #: jitter floor between failover attempts when the backend gave no
+    #: Retry-After (seconds)
+    failover_backoff: float = 0.05
+    #: per-replica budget for one rollout step: routing-layer drain +
+    #: reload + readiness probe (seconds)
+    rollout_timeout: float = 120.0
+    #: TTFT objective for router/fleet_goodput, from the forwarded trace
+    #: payloads (ms; 0 = every completed request counts good)
+    slo_ttft_ms: float = 500.0
+    #: watchdog budget for a prober sweep (0 = watchdog off)
+    stall_timeout: float = 0.0
+
+    def __post_init__(self):
+        if not self.backends:
+            raise ValueError(
+                "router.backends must name at least one replica "
+                "(host:port[,host:port...])"
+            )
+        if self.page_size < 1:
+            raise ValueError("router.page_size must be >= 1 token")
+        if self.probe_interval <= 0:
+            raise ValueError("router.probe_interval must be > 0 seconds")
+        if self.failover_retries < 0:
+            raise ValueError("router.failover_retries must be >= 0")
+
+    @classmethod
+    def from_dict(cls, config: Optional[dict]) -> "RouterConfig":
+        from trlx_tpu.data.method_configs import filter_known_fields
+
+        return cls(**filter_known_fields(cls, config or {}))
+
+
+class AffinityIndex:
+    """Host-side index over recently routed prompt blocks.
+
+    Flat map from block-chain prefixes (tuples of ``page_size``-token
+    block tuples) to the replica that last served a prompt through that
+    chain. The block math mirrors trlx_tpu.serve.paged.RadixCache: a
+    prompt of L tokens commits ``(L - 1) // page_size`` full blocks (the
+    final partial block is never cacheable). Matching walks from the
+    longest prefix down; inserting claims every prefix length for the
+    routed replica (which now genuinely holds the whole chain in its
+    radix cache). LRU-capped at ``max_entries``.
+
+    NOT thread-safe on its own — the router serializes access under its
+    membership lock.
+    """
+
+    def __init__(self, page_size: int, max_entries: int = 4096):
+        self.page_size = int(page_size)
+        self.max_entries = int(max_entries)
+        #: block-chain prefix -> [backend, last-use tick]
+        self._entries: Dict[Tuple, List] = {}
+        self._tick = 0
+
+    def blocks(self, tokens) -> List[Tuple]:
+        """Committed-prefix blocks of ``tokens`` — same cap as the paged
+        radix cache, so the index predicts what a replica CAN hit."""
+        ps = self.page_size
+        n_full = max((len(tokens) - 1) // ps, 0)
+        return [tuple(tokens[i * ps:(i + 1) * ps]) for i in range(n_full)]
+
+    def match(self, tokens, allow) -> Tuple[Optional[Any], int]:
+        """(backend, depth) of the longest indexed prefix of ``tokens``
+        owned by a backend ``allow`` accepts; (None, 0) on a miss."""
+        blocks = self.blocks(tokens)
+        for depth in range(len(blocks), 0, -1):
+            entry = self._entries.get(tuple(blocks[:depth]))
+            if entry is not None and allow(entry[0]):
+                self._tick += 1
+                entry[1] = self._tick
+                return entry[0], depth
+        return None, 0
+
+    def insert(self, tokens, backend) -> int:
+        """Claim every committed-prefix length of ``tokens`` for
+        ``backend``; returns the number of blocks indexed."""
+        blocks = self.blocks(tokens)
+        for depth in range(1, len(blocks) + 1):
+            self._tick += 1
+            self._entries[tuple(blocks[:depth])] = [backend, self._tick]
+        if len(self._entries) > self.max_entries:
+            self._evict()
+        return len(blocks)
+
+    def decay(self, tokens, backend, reported_blocks: int,
+              predicted_blocks: int) -> int:
+        """Feedback from the replica's trace payload: it hit only
+        ``reported_blocks`` of the ``predicted_blocks`` the index
+        promised, so the deeper entries are stale (the replica evicted
+        those pages under pressure) — drop them. Returns entries
+        dropped."""
+        dropped = 0
+        blocks = self.blocks(tokens)
+        hi = min(predicted_blocks, len(blocks))
+        for depth in range(max(reported_blocks, 0) + 1, hi + 1):
+            key = tuple(blocks[:depth])
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] is backend:
+                del self._entries[key]
+                dropped += 1
+        return dropped
+
+    def drop_backend(self, backend) -> int:
+        """Forget every entry owned by ``backend`` (its process died —
+        the cache died with it). Returns entries dropped."""
+        stale = [k for k, v in self._entries.items() if v[0] is backend]
+        for k in stale:
+            del self._entries[k]
+        return len(stale)
+
+    def _evict(self) -> None:
+        """LRU: drop the oldest quarter in one pass (amortizes the scan
+        instead of paying it per insert at the cap)."""
+        by_age = sorted(self._entries.items(), key=lambda kv: kv[1][1])
+        for k, _ in by_age[:max(len(by_age) // 4, 1)]:
+            del self._entries[k]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class Backend:
+    """One engine replica as the router sees it. All fields are written
+    under the router's membership lock."""
+
+    def __init__(self, spec: str):
+        spec = spec.strip()
+        if "//" not in spec:
+            spec = "http://" + spec
+        self.url = spec.rstrip("/")
+        self.admitted = False     # routable (prober- and rollout-driven)
+        self.ever_admitted = False  # first admission vs RE-admission
+        self.rolling = False      # fenced by an in-progress rollout step
+        self.queue_depth = 0
+        self.degraded = False
+        self.model_version = 0
+        self.requests = 0         # requests routed here (lifetime)
+        self.probe_failures = 0   # consecutive
+
+    def state(self) -> dict:
+        return {
+            "url": self.url,
+            "admitted": self.admitted,
+            "rolling": self.rolling,
+            "queue_depth": self.queue_depth,
+            "degraded": self.degraded,
+            "model_version": self.model_version,
+            "requests": self.requests,
+        }
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: "FleetRouter" = None  # set per-server via type()
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        return
+
+    def _json(self, code: int, payload: dict, headers=None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        rt = self.router
+        if self.path == "/healthz":
+            self._json(200, rt.fleet_state())
+        elif self.path == "/readyz":
+            admitting = rt.admitting_count()
+            self._json(200 if admitting else 503, {
+                "ready": admitting > 0,
+                "admitting": admitting,
+                "fleet_size": len(rt.backends),
+            })
+        elif self.path == "/metrics":
+            accept = self.headers.get("Accept", "") or ""
+            wants_text = any(
+                key in accept.lower()
+                for key in ("text/plain", "openmetrics", "prometheus")
+            )
+            if wants_text:
+                from trlx_tpu.telemetry import prometheus
+
+                self._text(
+                    200, telemetry.prometheus_text(), prometheus.CONTENT_TYPE
+                )
+            else:
+                self._json(200, telemetry.summary())
+        else:
+            self._json(404, {"error": f"no route '{self.path}' (have "
+                                      f"/generate, /admin/rollout [POST], "
+                                      f"/healthz, /readyz, /metrics)"})
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
+        rt = self.router
+        request_id = self.headers.get("X-Request-Id") or None
+        try:
+            hops = int(self.headers.get("X-Hop-Count") or 0)
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        if self.path == "/admin/rollout":
+            result = rt.rollout(body.get("checkpoint"))
+            self._json(200 if result.get("ok") else 409, result)
+            return
+        if self.path != "/generate":
+            self._json(404, {"error": f"no POST route '{self.path}' "
+                                      f"(have /generate, /admin/rollout)"})
+            return
+        status, payload, headers = rt.forward(
+            body, trace_id=request_id, hops=hops
+        )
+        self._json(status, payload, headers=headers)
+
+
+class FleetRouter:
+    """The fleet front end: affinity router + health prober + rolling
+    upgrades + fleet metrics, over plain HTTP. See the module docstring
+    for the design; :class:`RouterConfig` for the knobs."""
+
+    def __init__(self, config: RouterConfig):
+        self.config = config
+        self.backends = [Backend(spec) for spec in config.backends]
+        self.affinity = AffinityIndex(
+            config.page_size, max_entries=config.affinity_entries
+        )
+        #: membership + affinity + goodput tallies; every Backend field
+        #: write happens under it
+        self._lock = threading.Lock()
+        self._slo_good = 0    # guarded-by: _lock
+        self._slo_total = 0   # guarded-by: _lock
+        #: one rollout at a time; held for the whole walk
+        self._rollout_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._probe_thread: Optional[threading.Thread] = None  # guarded-by: _stop_lock
+        self._httpd: Optional[ThreadingHTTPServer] = None  # guarded-by: _stop_lock
+        self._http_thread: Optional[threading.Thread] = None  # guarded-by: _stop_lock
+        sup = None
+        if config.stall_timeout > 0:
+            # like serving, routing has no checkpoint to rescue: a
+            # wedged prober escalates to abort so the orchestrator
+            # restarts a fresh router
+            sup = RunSupervisor(
+                stall_timeout=config.stall_timeout, stall_action="abort"
+            )
+        self.supervisor = sup
+        self.host = config.host
+        self.port = config.port
+
+    # -- backend HTTP client (every call carries an explicit timeout) --- #
+
+    def _get_json(self, url: str, timeout: float) -> Tuple[int, dict]:
+        req = urllib.request.Request(url, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def _post_json(self, url: str, payload: dict, timeout: float,
+                   headers: Optional[dict] = None
+                   ) -> Tuple[int, dict, dict]:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, dict(resp.headers), \
+                    json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+    # -- membership: the prober ----------------------------------------- #
+
+    def _probe_loop(self) -> None:
+        sup_cm = self.supervisor
+        if sup_cm is None:
+            sup_cm = contextlib.nullcontext()
+        with sup_cm:
+            while not self._stop.wait(self.config.probe_interval):
+                with supervisor.phase("router_probe"):
+                    try:
+                        self.probe_fleet()
+                    except chaos.ChaosError as e:
+                        # containment drill: a failed sweep leaves
+                        # membership untouched — next sweep recovers
+                        print(f"[trlx_tpu.router] probe sweep failed: "
+                              f"{e}", flush=True)
+
+    def probe_fleet(self) -> None:
+        """One prober sweep: refresh every backend's admission, queue
+        depth, degraded flag, and model version; update fleet gauges."""
+        chaos.maybe_inject("router_probe")
+        timeout = self.config.probe_timeout
+        for b in self.backends:
+            ready, state = False, None
+            try:
+                code, body = self._get_json(b.url + "/readyz", timeout)
+                ready = code == 200 and bool(body.get("ready"))
+                version = int(body.get("model_version") or 0)
+                _, state = self._get_json(b.url + "/debug/state", timeout)
+            except (OSError, ValueError) as e:
+                # unreachable / torn response: treated as not-ready; the
+                # reason is logged once per transition below
+                version = 0
+                state = {"probe_error": f"{type(e).__name__}: {e}"}
+            self._apply_probe(b, ready, version, state or {})
+        self._update_fleet_gauges()
+
+    def _apply_probe(self, b: Backend, ready: bool, version: int,
+                     state: dict) -> None:
+        with self._lock:
+            if ready:
+                b.probe_failures = 0
+                b.queue_depth = int(state.get("queue_depth", b.queue_depth))
+                b.degraded = bool(state.get("degraded", False))
+                if version:
+                    b.model_version = version
+                if not b.admitted and not b.rolling:
+                    if b.ever_admitted:
+                        telemetry.inc("router/readmissions")
+                        print(f"[trlx_tpu.router] re-admitted {b.url} "
+                              f"(model_version {b.model_version})",
+                              flush=True)
+                    b.admitted = True
+                    b.ever_admitted = True
+            else:
+                b.probe_failures += 1
+                if b.admitted:
+                    b.admitted = False
+                    telemetry.inc("router/ejections")
+                    # its radix cache is unreachable (or gone): stop
+                    # predicting hits against it
+                    self.affinity.drop_backend(b)
+                    print(f"[trlx_tpu.router] ejected {b.url} "
+                          f"({state.get('probe_error', 'not ready')})",
+                          flush=True)
+
+    def _update_fleet_gauges(self) -> None:
+        with self._lock:
+            admitted = [b for b in self.backends if b.admitted]
+            versions = [b.model_version for b in admitted if b.model_version]
+            telemetry.set_gauge("router/admitting", float(len(admitted)))
+            telemetry.set_gauge(
+                "router/degraded_backends",
+                float(sum(1 for b in admitted if b.degraded)),
+            )
+            # min over admitted replicas: the gauge CONVERGES to the new
+            # version exactly when the last replica finishes its rollout
+            telemetry.set_gauge(
+                "router/fleet_model_version",
+                float(min(versions)) if versions else 0.0,
+            )
+
+    def admitting_count(self) -> int:
+        with self._lock:
+            return sum(1 for b in self.backends if b.admitted)
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        """Block until at least one replica is admitted (tests/CLI)."""
+        deadline = monotonic() + timeout
+        while monotonic() < deadline:
+            if self.admitting_count() > 0:
+                return True
+            self._stop.wait(0.05)
+        return self.admitting_count() > 0
+
+    def fleet_state(self) -> dict:
+        with self._lock:
+            return {
+                "status": "ok",
+                "fleet_size": len(self.backends),
+                "admitting": sum(1 for b in self.backends if b.admitted),
+                "backends": [b.state() for b in self.backends],
+                "affinity_entries": len(self.affinity),
+                "rollout_in_progress": self._rollout_lock.locked(),
+            }
+
+    # -- routing --------------------------------------------------------- #
+
+    def _affinity_key(self, body: dict):
+        """The sequence the affinity index blocks over: token ids when
+        the client sent them, else the prompt string's characters (an
+        approximation — block boundaries then track characters, not
+        tokens, but shared string prefixes still cluster)."""
+        if "tokens" in body:
+            return [int(t) for t in body["tokens"]]
+        return str(body.get("prompt", ""))
+
+    def _pick(self, key, exclude) -> Tuple[Optional[Backend], int, str]:
+        """(backend, predicted-depth, how) under the membership lock:
+        longest affinity match first, else least-loaded with a degraded
+        replica's share halved (its effective queue depth doubled)."""
+        with self._lock:
+            admitted = [b for b in self.backends
+                        if b.admitted and b not in exclude]
+            if not admitted:
+                return None, 0, ""
+            allowed = set(admitted)
+            backend, depth = self.affinity.match(
+                key, lambda b: b in allowed
+            )
+            if backend is not None:
+                return backend, depth, "affinity"
+            backend = min(
+                admitted,
+                key=lambda b: (
+                    (b.queue_depth + 1) * (2 if b.degraded else 1),
+                    b.requests,
+                ),
+            )
+            return backend, 0, "least_loaded"
+
+    def forward(self, body: dict, trace_id: Optional[str] = None,
+                hops: int = 0) -> Tuple[int, dict, dict]:
+        """Route one ``/generate`` body: pick a replica, forward with
+        the trace id and hop count stamped through, fail over
+        idempotent-safe errors onto a second replica honoring its
+        ``Retry-After``. Returns (status, payload, response-headers) for
+        the HTTP layer; also the direct entry point for in-process
+        callers (tests, bench)."""
+        telemetry.inc("router/requests")
+        started = monotonic()
+        try:
+            # fired ONCE per request, before any replica is picked, so an
+            # injected exc is the router's own 500 path — failover below
+            # only covers real upstream failures
+            chaos.maybe_inject("router_route")
+        except chaos.ChaosError as e:
+            telemetry.inc("router/request_errors")
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        trace_id = trace_id or new_trace_id()
+        key = self._affinity_key(body)
+        # the replica's trace payload is the affinity feedback signal, so
+        # the router always requests it and strips it back off below when
+        # the CLIENT did not ask for it
+        client_wants_trace = bool(body.get("trace"))
+        fwd_body = dict(body)
+        fwd_body["trace"] = True
+        tried: List[Backend] = []
+        picked: List[Tuple[Backend, int, str]] = []
+
+        def attempt():
+            backend, depth, how = self._pick(key, exclude=tried)
+            if backend is None:
+                raise NoBackendAvailable(
+                    f"no admitting replica (fleet of {len(self.backends)}; "
+                    f"{len(tried)} already tried this request)"
+                )
+            if tried:
+                telemetry.inc("router/failovers")
+            tried.append(backend)
+            picked.append((backend, depth, how))
+            try:
+                status, headers, payload = self._post_json(
+                    backend.url + "/generate", fwd_body,
+                    timeout=self.config.request_timeout,
+                    headers={
+                        "X-Request-Id": trace_id,
+                        "X-Hop-Count": str(hops + 1),
+                    },
+                )
+            except (OSError, ValueError) as e:
+                raise _UpstreamRetryable(
+                    f"{backend.url} unreachable "
+                    f"({type(e).__name__}: {e})"
+                ) from e
+            if status in (429, 503):
+                retry_after = headers.get("Retry-After")
+                raise _UpstreamRetryable(
+                    f"{backend.url} answered {status}: "
+                    f"{payload.get('error', '')}",
+                    status=status,
+                    retry_after_s=float(retry_after)
+                    if retry_after else None,
+                    payload=payload,
+                )
+            return status, headers, payload
+
+        try:
+            status, headers, payload = retry_call(
+                attempt,
+                retries=self.config.failover_retries,
+                backoff=self.config.failover_backoff,
+                label="router_forward",
+                retry_after_s=lambda e: getattr(e, "retry_after_s", None),
+            )
+        except NoBackendAvailable as e:
+            telemetry.inc("router/request_errors")
+            return 503, {"error": str(e)}, {}
+        except _UpstreamRetryable as e:
+            # budget exhausted: surface the LAST upstream answer (429
+            # keeps its pacing semantics; connection errors become 503)
+            telemetry.inc("router/request_errors")
+            out_headers = {}
+            if e.retry_after_s is not None:
+                out_headers["Retry-After"] = str(int(e.retry_after_s))
+            return e.status or 503, e.payload, out_headers
+
+        backend, depth, how = picked[-1]
+        self._note_routed(backend, key, depth, how, status, payload)
+        telemetry.inc("router/responses")
+        telemetry.observe("router/forward_time", monotonic() - started)
+        out_headers = {"X-Request-Id": payload.get("trace_id", trace_id)}
+        if not client_wants_trace:
+            payload.pop("trace", None)
+        return status, payload, out_headers
+
+    def _note_routed(self, backend: Backend, key, depth: int, how: str,
+                     status: int, payload: dict) -> None:
+        """Post-response bookkeeping: per-backend tallies, the affinity
+        insert + trace-feedback decay, hit rate, fleet goodput."""
+        trace = payload.get("trace") if isinstance(payload, dict) else None
+        with self._lock:
+            backend.requests += 1
+            if how == "affinity":
+                telemetry.inc("router/affinity_hits")
+            else:
+                telemetry.inc("router/affinity_misses")
+            if status == 200:
+                predicted = self.affinity.insert(key, backend)
+                if depth and isinstance(trace, dict) \
+                        and "prefix_blocks_hit" in trace:
+                    dropped = self.affinity.decay(
+                        key, backend,
+                        int(trace["prefix_blocks_hit"]),
+                        min(depth, predicted),
+                    )
+                    if dropped:
+                        telemetry.inc("router/affinity_decays", dropped)
+            tel = telemetry.current()
+            if tel is not None:
+                hits = tel.registry.counters.get("router/affinity_hits", 0.0)
+                misses = tel.registry.counters.get(
+                    "router/affinity_misses", 0.0
+                )
+                telemetry.set_gauge(
+                    "router/affinity_hit_rate",
+                    hits / max(hits + misses, 1.0),
+                )
+            if status == 200:
+                self._slo_total += 1
+                slo = self.config.slo_ttft_ms
+                ttft_ms = (trace or {}).get("ttft_ms")
+                if slo <= 0 or ttft_ms is None or ttft_ms <= slo:
+                    self._slo_good += 1
+                telemetry.set_gauge(
+                    "router/fleet_goodput",
+                    self._slo_good / max(self._slo_total, 1),
+                )
+
+    # -- rolling checkpoint upgrades -------------------------------------- #
+
+    def rollout(self, checkpoint: Optional[str] = None) -> dict:
+        """Walk the fleet one replica at a time: fence from routing,
+        wait for its in-flight work, ``/admin/reload``, smoke-probe
+        ``/readyz``, re-admit. A failed step re-admits the replica on
+        its old weights and ABORTS (the fleet keeps serving, operators
+        keep a consistent version set to reason about). Never drops
+        below N-1 admitting replicas."""
+        if not self._rollout_lock.acquire(blocking=False):
+            return {"ok": False, "reason": "a rollout is already in "
+                                           "progress (one at a time)"}
+        telemetry.inc("router/rollouts")
+        telemetry.set_gauge("router/rollout_in_progress", 1.0)
+        steps = []
+        try:
+            for b in list(self.backends):
+                try:
+                    chaos.maybe_inject("router_rollout")
+                    step = self._rollout_one(b, checkpoint)
+                except chaos.ChaosError as e:
+                    step = {"backend": b.url, "ok": False,
+                            "reason": f"{type(e).__name__}: {e}"}
+                telemetry.inc("router/rollout_steps")
+                steps.append(step)
+                if not step["ok"]:
+                    telemetry.inc("router/rollout_aborts")
+                    print(f"[trlx_tpu.router] rollout ABORTED at "
+                          f"{b.url}: {step.get('reason')}", flush=True)
+                    return {"ok": False, "aborted_at": b.url,
+                            "steps": steps}
+            self._update_fleet_gauges()
+            print(f"[trlx_tpu.router] rollout complete "
+                  f"({len(steps)} replicas)", flush=True)
+            return {"ok": True, "steps": steps}
+        finally:
+            telemetry.set_gauge("router/rollout_in_progress", 0.0)
+            self._rollout_lock.release()
+
+    def _rollout_one(self, b: Backend,
+                     checkpoint: Optional[str]) -> dict:
+        deadline = monotonic() + self.config.rollout_timeout
+        # 1. fence: the routing-layer drain. The ENGINE's /admin/drain is
+        # process-terminal (crash-only: drained replicas exit), so for an
+        # in-place upgrade the router stops routing to the replica and
+        # waits for its in-flight work instead.
+        with self._lock:
+            was_admitted, b.admitted = b.admitted, False
+            b.rolling = True
+        self._update_fleet_gauges()
+        try:
+            quiesced = self._wait_quiesced(b, deadline)
+            if not quiesced:
+                return {"backend": b.url, "ok": False,
+                        "reason": "replica did not quiesce within "
+                                  "router.rollout_timeout"}
+            # 2. reload: the engine smoke-probes and rolls back itself
+            # (serve/reload_failures); 409 = probe rejected the weights
+            try:
+                code, _, body = self._post_json(
+                    b.url + "/admin/reload",
+                    {"checkpoint": checkpoint} if checkpoint else {},
+                    timeout=self.config.rollout_timeout,
+                )
+            except (OSError, ValueError) as e:
+                return {"backend": b.url, "ok": False,
+                        "reason": f"reload unreachable "
+                                  f"({type(e).__name__}: {e})"}
+            if code != 200 or not body.get("reloaded"):
+                return {"backend": b.url, "ok": False,
+                        "reason": body.get("reason")
+                        or body.get("error")
+                        or f"reload answered {code}"}
+            version = int(body.get("model_version") or 0)
+            # 3. smoke-probe readiness on the new version
+            if not self._wait_ready_version(b, version, deadline):
+                return {"backend": b.url, "ok": False,
+                        "reason": f"replica not ready on model_version "
+                                  f"{version} within the rollout budget"}
+            with self._lock:
+                b.model_version = version
+            return {"backend": b.url, "ok": True,
+                    "model_version": version}
+        finally:
+            # 4. ALWAYS re-admit (success: new weights; failure: the old
+            # weights still serve — aborting must not shrink the fleet)
+            with self._lock:
+                b.rolling = False
+                b.admitted = was_admitted or b.admitted
+            self._update_fleet_gauges()
+
+    def _wait_quiesced(self, b: Backend, deadline: float) -> bool:
+        while monotonic() < deadline:
+            try:
+                _, state = self._get_json(
+                    b.url + "/debug/state", self.config.probe_timeout
+                )
+            except (OSError, ValueError):
+                # unreachable mid-rollout: treat as quiesced — the
+                # reload call right after will surface the real failure
+                return True
+            if not state.get("queue_depth") and not state.get("slots"):
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return False
+
+    def _wait_ready_version(self, b: Backend, version: int,
+                            deadline: float) -> bool:
+        while monotonic() < deadline:
+            try:
+                code, body = self._get_json(
+                    b.url + "/readyz", self.config.probe_timeout
+                )
+            except (OSError, ValueError):
+                code, body = 0, {}
+            if code == 200 and body.get("ready") \
+                    and int(body.get("model_version") or 0) >= version:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return False
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> "FleetRouter":
+        telemetry.predeclare(_ROUTER_COUNTERS)
+        telemetry.set_gauge("router/fleet_size", float(len(self.backends)))
+        telemetry.set_gauge("router/admitting", 0.0)
+        telemetry.set_gauge("router/degraded_backends", 0.0)
+        telemetry.set_gauge("router/fleet_model_version", 0.0)
+        telemetry.set_gauge("router/affinity_hit_rate", 0.0)
+        telemetry.set_gauge("router/fleet_goodput", 0.0)
+        telemetry.set_gauge("router/rollout_in_progress", 0.0)
+        # one synchronous sweep so start() returns with membership known
+        # (a request racing the first probe would 503 spuriously)
+        self.probe_fleet()
+        self._stop.clear()
+        probe = threading.Thread(
+            target=self._probe_loop, name="trlx-router-probe", daemon=True
+        )
+        handler = type("Handler", (_RouterHandler,), {"router": self})
+        httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = httpd.server_address[1]  # resolve port=0
+        http_thread = threading.Thread(
+            target=httpd.serve_forever, name="trlx-router-http", daemon=True
+        )
+        with self._stop_lock:
+            self._probe_thread = probe
+            self._httpd = httpd
+            self._http_thread = http_thread
+        probe.start()
+        http_thread.start()
+        print(f"[trlx_tpu.router] routing http://{self.host}:{self.port} "
+              f"-> {[b.url for b in self.backends]} "
+              f"({self.admitting_count()}/{len(self.backends)} admitting)",
+              flush=True)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._stop_lock:
+            probe, self._probe_thread = self._probe_thread, None
+            httpd, self._httpd = self._httpd, None
+            http_thread, self._http_thread = self._http_thread, None
+        if probe is not None:
+            probe.join(timeout=5.0)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if http_thread is not None:
+            http_thread.join(timeout=5.0)
+
+    def serve_forever(self) -> None:
+        """Block until interrupted (the CLI's tail)."""
+        try:
+            while not self._stop.wait(timeout=1.0):
+                continue
+        except KeyboardInterrupt:
+            print("[trlx_tpu.router] interrupted; stopping", flush=True)
+        finally:
+            self.stop()

@@ -1,0 +1,86 @@
+"""``python -m trlx_tpu.router`` — backend list in, fleet endpoint out.
+
+The minimal launch is just ``--backends host:port,host:port``; the
+``router:`` section of a training YAML (``--config``) supplies the rest,
+and the flags below win over both. Stdlib-only, no JAX: the router runs
+happily on a CPU-only front-end box in front of TPU replicas. See
+docs/source/serving.rst ("Fleet routing").
+"""
+
+import argparse
+import sys
+
+import yaml
+
+from trlx_tpu import telemetry
+from trlx_tpu.router import FleetRouter, RouterConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.router",
+        description="Front a fleet of trlx_tpu.serve replicas with "
+                    "prefix-affinity routing and rolling upgrades.",
+    )
+    p.add_argument("--backends", default=None,
+                   help="comma-separated replica endpoints, e.g. "
+                        "'10.0.0.1:8081,10.0.0.2:8081' (required here "
+                        "or in the YAML router: section)")
+    p.add_argument("--config", default=None,
+                   help="training YAML whose router: section supplies "
+                        "defaults for the flags below")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--page-size", type=int, default=None,
+                   help="affinity-block granularity in tokens — match "
+                        "the backends' serve.page_size")
+    p.add_argument("--probe-interval", type=float, default=None,
+                   help="health-prober sweep period (seconds)")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="per-forward HTTP timeout toward a backend")
+    p.add_argument("--failover-retries", type=int, default=None,
+                   help="extra replicas tried after an idempotent-safe "
+                        "failure (connection error, 429, 503)")
+    p.add_argument("--rollout-timeout", type=float, default=None,
+                   help="per-replica budget for one rolling-upgrade "
+                        "step (drain + reload + readiness probe)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT objective for router/fleet_goodput "
+                        "(0 = every completed request counts good)")
+    p.add_argument("--stall-timeout", type=float, default=None,
+                   help="watchdog budget per prober sweep (0 = off)")
+    return p
+
+
+def router_config_from_args(args) -> RouterConfig:
+    """The router: YAML section (when --config names a file carrying
+    one) with CLI flags layered on top."""
+    section = {}
+    if args.config:
+        with open(args.config) as f:
+            section = (yaml.safe_load(f) or {}).get("router") or {}
+    if args.backends is not None:
+        section["backends"] = [
+            b.strip() for b in args.backends.split(",") if b.strip()
+        ]
+    cfg = RouterConfig.from_dict(section)
+    for flag in ("host", "port", "page_size", "probe_interval",
+                 "request_timeout", "failover_retries", "rollout_timeout",
+                 "slo_ttft_ms", "stall_timeout"):
+        value = getattr(args, flag)
+        if value is not None:
+            setattr(cfg, flag, value)
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    config = router_config_from_args(args)
+    telemetry.start()
+    router = FleetRouter(config).start()
+    router.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,14 @@ of the codebase's stdlib-only host layer. Four routes:
   way. ``serve.watch_checkpoints`` > 0 polls ``LATEST`` and reloads
   automatically.
 
+Proxy hygiene: every proxy in front of this server (the fleet router,
+trlx_tpu.router) increments ``X-Hop-Count`` as it forwards; a request
+arriving with more than :data:`MAX_HOPS` hops is rejected with a typed
+508 (:class:`HopLimitExceeded`, ``serve/hop_limit_rejects``) instead of
+looping forever through a router misconfigured to point at itself. The
+hop count is echoed back as a response header and in the ``"trace":
+true`` payload, so a trace shows how many proxies a request crossed.
+
 Request handling runs through :func:`trlx_tpu.supervisor.bounded_call`
 (``serve.request_timeout``): a request wedged behind a hung decode
 raises SeamTimeout in the handler (503 + ``fault/seam_timeouts``)
@@ -113,7 +121,20 @@ _SERVE_COUNTERS = (
     "serve/drains",
     "serve/reloads",
     "serve/reload_failures",
+    # proxy hygiene (fleet routing, docs "Serving"): requests rejected
+    # past the X-Hop-Count cap — a climbing counter means a routing loop
+    "serve/hop_limit_rejects",
 )
+
+#: proxy-hop ceiling: any sane fleet topology is 1-2 hops deep (client
+#: -> router -> replica); past this the request is looping, not routing
+MAX_HOPS = 8
+
+
+class HopLimitExceeded(RuntimeError):
+    """Inbound ``X-Hop-Count`` above :data:`MAX_HOPS` — a proxy loop
+    (e.g. a router whose backend list includes itself), mapped to 508
+    Loop Detected at the HTTP edge."""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -215,6 +236,23 @@ class _Handler(BaseHTTPRequestHandler):
         received_at = monotonic()
         request_id = self.headers.get("X-Request-Id") or None
         try:
+            hops = int(self.headers.get("X-Hop-Count") or 0)
+            if hops < 0:
+                raise ValueError
+        except ValueError:
+            self._error(400, "X-Hop-Count must be a non-negative integer")
+            return
+        if hops > MAX_HOPS:
+            # typed 508: a proxy loop, not a client or service error
+            telemetry.inc("serve/hop_limit_rejects")
+            e = HopLimitExceeded(
+                f"X-Hop-Count {hops} exceeds the {MAX_HOPS}-hop proxy "
+                f"cap — routing loop? (a router listing itself as a "
+                f"backend forwards forever)"
+            )
+            self._error(508, str(e))
+            return
+        try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(body, dict):
@@ -250,7 +288,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             payload = bounded_call(
                 lambda: srv.handle_generate(
-                    body, trace_id=request_id, received_at=received_at
+                    body, trace_id=request_id, received_at=received_at,
+                    hops=hops,
                 ),
                 timeout=srv.engine.serve.request_timeout,
                 label="serve_request",
@@ -281,6 +320,8 @@ class _Handler(BaseHTTPRequestHandler):
         headers = {}
         if payload.get("trace_id"):
             headers["X-Request-Id"] = payload["trace_id"]
+        if hops:
+            headers["X-Hop-Count"] = str(hops)
         self._json(200, payload, headers=headers)
 
 
@@ -357,12 +398,14 @@ class InferenceServer:
     # -- request semantics ---------------------------------------------- #
 
     def handle_generate(self, body: dict, trace_id: Optional[str] = None,
-                        received_at: Optional[float] = None) -> dict:
+                        received_at: Optional[float] = None,
+                        hops: int = 0) -> dict:
         """One request end-to-end: tokenize, submit, wait, shape the
         response. Runs inside bounded_call — raising is the error path
-        (the handler maps exception types to HTTP codes). ``trace_id``
-        and ``received_at`` come from the HTTP edge; direct callers may
-        omit both (the scheduler mints a trace at submit)."""
+        (the handler maps exception types to HTTP codes). ``trace_id``,
+        ``received_at``, and ``hops`` (the inbound ``X-Hop-Count``, 0 =
+        no proxy in front) come from the HTTP edge; direct callers may
+        omit all three (the scheduler mints a trace at submit)."""
         chaos.maybe_inject("serve_request")
         if "tokens" in body:
             tokens = [int(t) for t in body["tokens"]]
@@ -400,6 +443,8 @@ class InferenceServer:
             payload["trace_id"] = req.trace.trace_id
             if body.get("trace"):
                 payload["trace"] = req.trace.to_dict()
+                if hops:
+                    payload["trace"]["hops"] = hops
         return payload
 
     # -- graceful drain --------------------------------------------------- #

@@ -35,7 +35,15 @@ A schedule is a ``;``-separated list of rules::
   in-flight batch fails like pre-replay containment), and
   ``serve_reload`` (fired at checkpoint hot-swap application, before
   the candidate weights install — an ``exc`` drives the
-  rollback-to-old-version path, ``serve/reload_failures``).
+  rollback-to-old-version path, ``serve/reload_failures``). The fleet
+  router (trlx_tpu.router) adds ``router_route`` (fired at request
+  routing, before a replica is picked — an ``exc`` surfaces as the
+  router's 500 error path without touching any backend), ``router_probe``
+  (fired at the top of each health-prober sweep — an ``exc`` proves a
+  failed sweep leaves fleet membership untouched rather than ejecting
+  everything), and ``router_rollout`` (fired at each per-replica rolling-
+  upgrade step, before the replica is fenced — an ``exc`` aborts the
+  rollout with every replica re-admitted on its old version).
 - ``action``: ``hang`` (block ``param`` seconds, default 3600 — a
   bounded seam times out, the watchdog sees everything else), ``exc``
   (raise :class:`ChaosError`), ``slow`` (sleep ``param`` seconds, default
@@ -95,6 +103,10 @@ KNOWN_SEAMS = (
     "serve_request",
     "serve_replay",
     "serve_reload",
+    # fleet-router seams (trlx_tpu.router; see the docstring's seam tour)
+    "router_route",
+    "router_probe",
+    "router_rollout",
 )
 
 _ACTIONS = ("hang", "exc", "slow", "sigterm")

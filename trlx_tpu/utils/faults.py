@@ -56,6 +56,7 @@ def retry_call(
     log: Callable[[str], None] = print,
     timeout: float = 0.0,
     seam: str = "",
+    retry_after_s: Any = None,
     **kwargs: Any,
 ):
     """``fn(*args, **kwargs)`` with up to ``retries`` retries on exception,
@@ -85,7 +86,16 @@ def retry_call(
     (trlx_tpu.supervisor.chaos — free unless a schedule is active);
     firing INSIDE the attempt means injected hangs are bounded by
     ``timeout`` and injected exceptions consume retries, exactly like
-    the real faults they stand in for."""
+    the real faults they stand in for.
+
+    ``retry_after_s`` is a per-attempt pacing hint for callers whose
+    failures carry a server-provided retry time (an HTTP 429/503 with a
+    ``Retry-After`` header — the fleet router's failover client): a
+    float, or a callable taking the attempt's exception and returning a
+    float (or None to decline). When the hint yields a value >= 0 the
+    next delay IS that value — the server knows its own backlog better
+    than our jitter does — and the jitter state is left untouched, so
+    attempts without a hint fall back to the decorrelated schedule."""
     from trlx_tpu import telemetry
     from trlx_tpu.supervisor import bounded_call
     from trlx_tpu.supervisor import chaos
@@ -111,7 +121,16 @@ def retry_call(
                 telemetry.inc("fault/host_giveups")
                 raise
             telemetry.inc("fault/host_retries")
-            if backoff > 0:
+            hint = None
+            if retry_after_s is not None:
+                hint = retry_after_s(e) if callable(retry_after_s) \
+                    else retry_after_s
+            if hint is not None and float(hint) >= 0:
+                # server-provided pacing beats jitter for THIS attempt;
+                # prev_delay is untouched so hintless attempts keep the
+                # decorrelated schedule
+                delay = float(hint)
+            elif backoff > 0:
                 delay = min(
                     _JITTER.uniform(backoff, prev_delay * 3.0),
                     backoff * (2.0 ** retries),
