@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -12,10 +12,22 @@
 lint:
 	python -m trlx_tpu.analysis
 
-check: lint
+check: lint kernels
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| true
+
+# Pallas kernel tier (trlx_tpu/ops): the fused-attention train kernels
+# and the paged-attention decode kernel, run in interpret mode on CPU —
+# the parity oracle the kernel-parity-tested lint rule points at.
+# Covers kernel-vs-jnp greedy/logit parity (bf16 bit-identical tokens,
+# int8 within tolerance), the int8 KV round-trip bound, and the
+# serve-engine sweeps with serve.attention: pallas. On a real TPU the
+# same tests exercise the compiled kernels.
+kernels:
+	env JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_pallas_attention.py tests/test_paged_kernel.py \
+		-q -m 'not slow'
 
 style:
 	@command -v ruff >/dev/null 2>&1 \

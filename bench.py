@@ -38,6 +38,8 @@ import numpy as np
 from trlx_tpu.telemetry.flops import (
     PEAK_FLOPS,
     decode_flops_per_token,
+    kv_bytes_per_token,
+    peak_flops,
     ppo_train_flops_per_token as model_flops_per_train_token,
 )
 
@@ -592,6 +594,30 @@ def bench_gptj6b():
         out["gptj6b_precheck_hbm_source"] = src
         log(f"gpt-j-6B single-chip hydra precheck: "
             f"{out['gptj6b_single_chip_precheck']}")
+        # serve-tier sibling estimate: the SAME chip once the hydra is
+        # stripped for serving with serve.weights_dtype/kv_dtype: int8
+        # — int8 block weights (+ per-channel f32 scales), bf16
+        # embeddings, and the bench decode load's KV at the int8 tier
+        d, f, L, V = (spec.d_model, spec.d_ff, spec.n_layer,
+                      spec.vocab_size)
+        per_layer = 4 * d * d + 2 * d * f
+        embed = (V + spec.n_positions) * d
+        lm_head = 0 if spec.tie_lm_head else V * d
+        serve_int8 = (
+            L * per_layer * 1            # int8 codes
+            + L * (5 * d + 2 * f) * 4    # per-output-channel f32 scales
+            + (embed + lm_head) * 2      # embeddings/head stay bf16
+            + 8 * 52 * kv_bytes_per_token(spec, "int8")  # decode-leg KV
+        ) / 2**30
+        serve_verdict = ("would fit" if serve_int8 < limit_gb
+                         else "would raise")
+        out["gptj6b_precheck_serve_int8_gb"] = round(serve_int8, 2)
+        out["gptj6b_precheck_serve_int8"] = (
+            f"analytic int8 serve tier: {serve_int8:.1f} GB weights+KV "
+            f"vs {limit_gb:.1f} GB HBM ({src}) -> {serve_verdict}"
+        )
+        log(f"gpt-j-6B int8 serve-tier estimate: "
+            f"{out['gptj6b_precheck_serve_int8']}")
 
     # --- 2. 6B decode on the chip (the part that DOES fit) --------------- #
     B, P, G = 8, 4, 48
@@ -1065,7 +1091,19 @@ def bench_serving(n_requests=96, trace_seed=17):
     "chips" share the same cores, > 1 where per-chip bandwidth is
     real), plus TTFT/ITL p95 deltas against the paged leg.
 
-    Every leg also reports the request-lifecycle SLO metrics
+    Leg 5 — kernel A/B: the mixed trace on the paged engine with
+    ``serve.attention: pallas`` (the fused paged-attention decode
+    kernel) vs ``jnp`` — both report ``serve_decode_mfu``, the
+    decode-MFU-gap headline. Off-TPU the kernel runs interpret mode on
+    a truncated trace, so only the MFU pair and parity matter there.
+
+    Leg 6 — int8 KV tier: the mixed trace with ``serve.kv_dtype:
+    int8`` (pages stored as int8 codes + per-(token, kv-head) f32
+    scales). Reports ``serve_slots_per_gb_int8`` — the acceptance bar
+    is >= 1.8x the bf16 ``serve_slots_per_gb`` at this geometry.
+
+    Every leg also reports ``serve_decode_mfu`` (None off-TPU, where no
+    bf16 peak is defined) and the request-lifecycle SLO metrics
     (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
     ``serve_itl_p50/p95_ms``, and the paged leg runs an extra
     tracing-OFF pass first so ``serve_trace_overhead_frac`` is the
@@ -1112,7 +1150,15 @@ def bench_serving(n_requests=96, trace_seed=17):
     )
     engine = InferenceEngine(config, serve=serve_cfg)
     spec = engine.spec
-    kv_token_bytes = 2 * spec.n_layer * spec.kv_heads * spec.head_dim * 2
+    kv_token_bytes = kv_bytes_per_token(spec)  # bf16 tier
+    peak = peak_flops()
+
+    def decode_mfu(leg):
+        # analytic decode flops x useful tok/s over the chip's bf16
+        # peak; None off-TPU (same convention as the decode leg in main)
+        if peak is None:
+            return None
+        return round(decode_flops_per_token(spec) * leg["tok_s"] / peak, 4)
 
     rng = np.random.default_rng(trace_seed)
     trace = [
@@ -1211,6 +1257,55 @@ def bench_serving(n_requests=96, trace_seed=17):
         f"{mean_pages:.2f} pages/request -> {slots_per_gb_paged:,.0f} "
         f"slots/GB vs {slots_per_gb_contig:,.0f} contiguous "
         f"({slots_per_gb_paged / max(slots_per_gb_contig, 1e-9):.2f}x)")
+
+    # kernel A/B: the SAME paged engine and trace, decode attention
+    # routed through the fused Pallas kernel instead of the jnp gather
+    # path (serve.attention). Off-TPU the kernel runs in interpret mode
+    # — correct but slow — so the A/B replays a truncated trace there;
+    # the tok/s ratio is only meaningful on real chips, the MFU pair is
+    # the headline either way.
+    engine.serve.attention = "pallas"
+    telemetry.start()
+    on_tpu = jax.default_backend() == "tpu"
+    ab_trace = trace if on_tpu else trace[:16]
+    pallas_leg, _ = replay_slots(ab_trace)
+    engine.serve.attention = "jnp"
+    if not on_tpu:
+        telemetry.start()
+        jnp_ab, _ = replay_slots(ab_trace)
+    else:
+        jnp_ab = paged
+    pallas_vs_jnp = pallas_leg["tok_s"] / max(jnp_ab["tok_s"], 1e-9)
+    log(f"serve[pallas]:     {pallas_leg['tok_s']:,.1f} useful tok/s "
+        f"({pallas_vs_jnp:.2f}x jnp paged"
+        f"{'' if on_tpu else ', interpret-mode subset'}); "
+        f"decode MFU pallas "
+        f"{decode_mfu(pallas_leg) if peak else 'n/a (no peak)'} vs jnp "
+        f"{decode_mfu(jnp_ab) if peak else 'n/a (no peak)'}")
+
+    # int8 KV tier: the mixed trace once more with pages stored as int8
+    # codes + per-(token, kv-head) f32 scales (serve.kv_dtype) — the
+    # page-pool capacity lever: bytes/token drop ~1.9x at this
+    # geometry, so one GB of KV HBM carries ~1.9x the slots
+    engine.serve.kv_dtype = "int8"
+    telemetry.start()
+    int8_leg, int8_stats = replay_slots()
+    engine.serve.kv_dtype = "bf16"
+    int8_hist = telemetry.current().registry.hists.get(
+        "serve/pages_per_request"
+    )
+    int8_pages = (
+        int8_hist.total / max(int8_hist.count, 1) if int8_hist else 0.0
+    )
+    kv_token_bytes_int8 = kv_bytes_per_token(spec, "int8")
+    slots_per_gb_int8 = 2**30 / (
+        max(int8_pages, 1e-9) * page_size * kv_token_bytes_int8
+    )
+    int8_gain = slots_per_gb_int8 / max(slots_per_gb_paged, 1e-9)
+    log(f"serve[int8-kv]:    {int8_leg['tok_s']:,.1f} useful tok/s, "
+        f"{kv_token_bytes_int8} KV bytes/token vs {kv_token_bytes} bf16 "
+        f"-> {slots_per_gb_int8:,.0f} slots/GB "
+        f"({int8_gain:.2f}x bf16 paged)")
 
     # shared-prefix trace: 4 system prompts x short unique tails — the
     # radix-cache scenario class (chat templates, few-shot headers)
@@ -1357,6 +1452,7 @@ def bench_serving(n_requests=96, trace_seed=17):
                 tp["itl_p95"] - paged["itl_p95"], 2
             ),
             **slo_keys(tp, "_tp"),
+            "serve_decode_mfu_tp": decode_mfu(tp),
             "serve_tp_workload": (
                 f"the {n_requests}-request mixed burst replayed on a "
                 f"serve.mesh tp=2 engine (KV pages + attention "
@@ -1406,6 +1502,32 @@ def bench_serving(n_requests=96, trace_seed=17):
         "serve_slots_per_gb_gain": round(
             slots_per_gb_paged / max(slots_per_gb_contig, 1e-9), 3
         ),
+        # analytic decode MFU per leg (None off-TPU, where no bf16 peak
+        # is defined) — the decode-MFU-gap headline the kernel chases
+        "serve_decode_mfu": decode_mfu(paged),
+        "serve_decode_mfu_static": decode_mfu(static),
+        "serve_decode_mfu_contiguous": decode_mfu(contig),
+        "serve_decode_mfu_prefix": decode_mfu(prefix),
+        "serve_decode_mfu_chaos": decode_mfu({"tok_s": chaos_tok_s}),
+        # kernel A/B: fused Pallas decode kernel vs the jnp gather path
+        "serve_decode_mfu_pallas": decode_mfu(pallas_leg),
+        "serve_decode_mfu_jnp": decode_mfu(jnp_ab),
+        "serve_pallas_tokens_per_sec": round(pallas_leg["tok_s"], 1),
+        "serve_pallas_vs_jnp": round(pallas_vs_jnp, 3),
+        "serve_kernel_ab_workload": (
+            "the mixed burst with serve.attention pallas vs jnp on the "
+            "same paged engine; off-TPU the kernel leg replays a "
+            "16-request subset in interpret mode, so only the MFU pair "
+            "and parity matter there"
+        ),
+        # int8 KV tier: page-pool capacity at serve.kv_dtype: int8
+        "serve_int8_tokens_per_sec": round(int8_leg["tok_s"], 1),
+        "serve_decode_mfu_int8": decode_mfu(int8_leg),
+        "serve_kv_bytes_per_token": kv_token_bytes,
+        "serve_kv_bytes_per_token_int8": kv_token_bytes_int8,
+        "serve_slots_per_gb_int8": round(slots_per_gb_int8, 1),
+        "serve_slots_per_gb_int8_gain": round(int8_gain, 3),
+        "serve_int8_kv_dtype_reported": int8_stats["kv_dtype"],
         "serve_prefix_prefill_tokens_saved": int(saved),
         "serve_prefix_tokens_saved_frac": round(saved_frac, 3),
         "serve_prefix_hit_rate": round(

@@ -1,0 +1,8 @@
+import numpy as np
+
+from trlx_tpu.ops import fixture_kernel
+
+
+def test_fixture_kernel_matches_reference():
+    q = np.ones((1, 8), np.float32)
+    np.testing.assert_array_equal(fixture_kernel.doubled(q), q * 2)

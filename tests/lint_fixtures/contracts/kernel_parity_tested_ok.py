@@ -1,0 +1,5 @@
+import jax.numpy as jnp
+
+
+def doubled(q):
+    return jnp.asarray(q) * 2

@@ -195,6 +195,38 @@ def test_chaos_seam_tested_quiet_with_a_drill():
     assert lint(files, select=["chaos-seam-tested"]) == []
 
 
+KERNEL = "trlx_tpu/ops/fixture_kernel.py"
+
+
+def test_kernel_parity_tested_fires_when_no_test_imports_kernel():
+    files = {KERNEL: fixture("contracts/kernel_parity_tested_bad.py")}
+    findings = lint(files, select=["kernel-parity-tested"])
+    assert len(findings) == 1
+    assert "trlx_tpu.ops.fixture_kernel" in findings[0].message
+
+
+def test_kernel_parity_tested_quiet_with_importing_test():
+    files = {
+        KERNEL: fixture("contracts/kernel_parity_tested_bad.py"),
+        "tests/test_fixture_kernel.py":
+            fixture("contracts/kernel_parity_drill.py"),
+    }
+    assert lint(files, select=["kernel-parity-tested"]) == []
+
+
+def test_kernel_parity_tested_quiet_without_pallas_call():
+    files = {KERNEL: fixture("contracts/kernel_parity_tested_ok.py")}
+    assert lint(files, select=["kernel-parity-tested"]) == []
+
+
+def test_kernel_parity_tested_ignores_modules_outside_ops():
+    files = {
+        "trlx_tpu/serve/mod.py":
+            fixture("contracts/kernel_parity_tested_bad.py"),
+    }
+    assert lint(files, select=["kernel-parity-tested"]) == []
+
+
 # --------------------------------------------------------------------- #
 # suppressions
 # --------------------------------------------------------------------- #

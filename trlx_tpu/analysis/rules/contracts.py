@@ -292,3 +292,61 @@ class ChaosSeamTestedRule(Rule):
                 if isinstance(t, ast.Name) and t.id == "KNOWN_SEAMS":
                     return node.lineno, _const_strings(node.value)
         return None
+
+
+@register
+class KernelParityTestedRule(Rule):
+    id = "kernel-parity-tested"
+    family = "contracts"
+    rationale = (
+        "a Pallas kernel that no test imports only ever runs on real "
+        "TPU hardware — its arithmetic is never exercised by tier-1, "
+        "so a drifted online-softmax or dequant step ships silently; "
+        "interpret-mode parity tests are the kernel's only CI oracle"
+    )
+    hint = (
+        "add a tests/ file that imports the module and asserts "
+        "kernel-vs-jnp parity (see tests/test_paged_kernel.py), or "
+        "drop the pallas_call from the module"
+    )
+
+    def run(self, project):
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.path.startswith("trlx_tpu/ops/"):
+                continue
+            line = self._pallas_call_line(ctx)
+            if line is None:
+                continue
+            module = ctx.path[:-len(".py")].replace("/", ".")
+            if not self._imported_by_tests(project, module):
+                yield self.finding(
+                    ctx, line,
+                    f"kernel module '{module}' calls pl.pallas_call but "
+                    f"is not imported by any tests/ file",
+                )
+
+    @staticmethod
+    def _pallas_call_line(ctx: FileContext) -> Optional[int]:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _callee_leaf(node) == "pallas_call"):
+                return node.lineno
+        return None
+
+    @staticmethod
+    def _imported_by_tests(project, module: str) -> bool:
+        parent, _, stem = module.rpartition(".")
+        for ctx in project.files.values():
+            if ctx.tree is None or not ctx.in_tests:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Import):
+                    if any(a.name == module for a in node.names):
+                        return True
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == module:
+                        return True
+                    if (node.module == parent
+                            and any(a.name == stem for a in node.names)):
+                        return True
+        return False

@@ -45,6 +45,7 @@ from trlx_tpu.models.transformer import (
     init_kv_cache,
     init_paged_kv_cache,
     layer_norm,
+    quantize_kv,
     positions_from_mask,
     project_logits,
 )
@@ -673,6 +674,26 @@ def _segments_of(blocks):
     return segments, seg_sizes
 
 
+def _kv_layer(entry, i):
+    """Layer ``i`` of one side of a per-segment pool entry — a plain
+    [L, ...] array (bf16 tier) or the int8 tier's (codes, scales) pair;
+    tree_map indexes both uniformly."""
+    return jax.tree_util.tree_map(lambda x: x[i], entry)
+
+
+def _kv_set_layer(entry, i, new):
+    return jax.tree_util.tree_map(
+        lambda c, l: c.at[i].set(l), entry, new
+    )
+
+
+def _pool_page_geometry(pool):
+    """(num_pages, page_size) of a page pool in either KV tier."""
+    k0 = pool[0][0]
+    k0 = k0[0] if isinstance(k0, (tuple, list)) else k0
+    return k0.shape[1], k0.shape[2]
+
+
 def prefill_into_slots(
     spec: ModelSpec,
     blocks: Params,
@@ -805,10 +826,16 @@ def _prefill_into_pages(
     positions = start[:, None] + jnp.arange(P)[None, :]
     h = embed_tokens(embed, spec, prompt_tokens, positions, compute_dtype)
 
+    quantized = isinstance(pool[0][0], (tuple, list))
     if not prefix_context:
         # no committed prefix: local causal prefill (the exact ops the
-        # contiguous path runs), then one block-scatter into the pages
-        cache_dtype = jax.tree_util.tree_leaves(pool)[0].dtype
+        # contiguous path runs), then one block-scatter into the pages.
+        # int8 tier: the LOCAL buffer stays full-precision in the compute
+        # dtype and quantization happens once at the scatter — the same
+        # source dtype block_apply's decode-time quantize sees, so page
+        # content stays a pure function of token content (radix dedupe).
+        cache_dtype = compute_dtype if quantized \
+            else jax.tree_util.tree_leaves(pool)[0].dtype
         cache_segs = [
             init_kv_cache(spec, size, B, P, cache_dtype)
             for size in seg_sizes
@@ -823,11 +850,23 @@ def _prefill_into_pages(
         pids = page_tables[:, pos_buf // page_size]  # [Bp, P]
         ioff = pos_buf % page_size  # [P], broadcasts against pids
         new_pool = []
-        for (k_pool, v_pool), (k_new, v_new) in zip(pool, cache_segs):
-            new_pool.append((
-                k_pool.at[:, pids, ioff].set(k_new, mode="drop"),
-                v_pool.at[:, pids, ioff].set(v_new, mode="drop"),
-            ))
+        for entry, (k_new, v_new) in zip(pool, cache_segs):
+            if quantized:
+                (k_pool, k_sc), (v_pool, v_sc) = entry
+                kq, ks = quantize_kv(k_new)  # [L,Bp,P,Hkv(,hd)]
+                vq, vs = quantize_kv(v_new)
+                new_pool.append((
+                    (k_pool.at[:, pids, ioff].set(kq, mode="drop"),
+                     k_sc.at[:, pids, ioff].set(ks, mode="drop")),
+                    (v_pool.at[:, pids, ioff].set(vq, mode="drop"),
+                     v_sc.at[:, pids, ioff].set(vs, mode="drop")),
+                ))
+            else:
+                k_pool, v_pool = entry
+                new_pool.append((
+                    k_pool.at[:, pids, ioff].set(k_new, mode="drop"),
+                    v_pool.at[:, pids, ioff].set(v_new, mode="drop"),
+                ))
     else:
         # prefix-suffix prefill: each suffix token attends to the
         # committed prefix pages (gathered inside block_apply's paged
@@ -846,12 +885,13 @@ def _prefill_into_pages(
                 p_i = jax.tree_util.tree_map(lambda x, i=i: x[i], seg)
                 h, (k_l, v_l) = block_apply(
                     spec, flags, p_i, h, bias, positions,
-                    kv_cache=(k_c[i], v_c[i]), cache_row_offsets=start,
+                    kv_cache=(_kv_layer(k_c, i), _kv_layer(v_c, i)),
+                    cache_row_offsets=start,
                     page_table=page_tables, page_size=page_size,
                     attention_fn=attention_fn,
                 )
-                k_c = k_c.at[i].set(k_l)
-                v_c = v_c.at[i].set(v_l)
+                k_c = _kv_set_layer(k_c, i, k_l)
+                v_c = _kv_set_layer(v_c, i, v_l)
             new_pool.append((k_c, v_c))
 
     # first-step logits from the last REAL suffix token (right padding:
@@ -895,6 +935,7 @@ def decode_step(
     config: GenerationConfig,
     compute_dtype=jnp.bfloat16,
     attention_fn=attention_scores,
+    paged_decode_fn=None,
 ):
     """One decode step for every pool slot: sample from each slot's
     carried logits, forward the sampled tokens against the pool (per-slot
@@ -910,6 +951,10 @@ def decode_step(
 
     ``config.gen_size`` is ignored (the cap is per-slot ``max_new``);
     ``min_new_tokens`` applies per slot against its ``generated`` count.
+
+    ``paged_decode_fn`` (``serve.attention: pallas``) is forwarded to
+    each layer's ``block_apply`` so the paged gather + score runs as the
+    fused kernel; ``None`` keeps the jnp oracle path.
     """
     S = state.offset.shape[0]
     segments, seg_sizes = _segments_of(blocks)
@@ -952,8 +997,7 @@ def decode_step(
         # their scatter drops — a harvested slot's pages may already
         # belong to ANOTHER slot, so the old "write into your own row"
         # harmlessness argument no longer holds
-        num_pages = pool[0][0].shape[1]
-        page_size = pool[0][0].shape[2]
+        num_pages, page_size = _pool_page_geometry(pool)
         pt_step = jnp.where(
             emitted[:, None], state.pages, jnp.int32(num_pages)
         )
@@ -963,14 +1007,15 @@ def decode_step(
             p_i = jax.tree_util.tree_map(lambda x, i=i: x[i], seg)
             h, (k_l, v_l) = block_apply(
                 spec, flags, p_i, h, bias, pos,
-                kv_cache=(k_c[i], v_c[i]),
+                kv_cache=(_kv_layer(k_c, i), _kv_layer(v_c, i)),
                 cache_row_offsets=state.offset,
                 page_table=pt_step if paged else None,
                 page_size=page_size if paged else None,
                 attention_fn=attention_fn,
+                paged_decode_fn=paged_decode_fn if paged else None,
             )
-            k_c = k_c.at[i].set(k_l)
-            v_c = v_c.at[i].set(v_l)
+            k_c = _kv_set_layer(k_c, i, k_l)
+            v_c = _kv_set_layer(v_c, i, v_l)
         new_pool.append((k_c, v_c))
     h_normed = layer_norm(ln_f, h, spec.layer_norm_epsilon)
     next_logits = project_logits(embed, spec, h_normed)[:, 0]  # [S, V]

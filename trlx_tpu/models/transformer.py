@@ -256,7 +256,16 @@ attention_scores.supports_gqa = True
 
 
 def _project(x, w, b=None):
-    y = x @ w.astype(x.dtype)
+    if isinstance(w, (tuple, list)):
+        # serve-only int8 weights (serve.weights_dtype: int8): (codes
+        # int8 [.., in, out], per-output-channel scale f32 [.., 1, out]).
+        # The scale factors out of the contraction, so dequant is one
+        # broadcast multiply on the [.., out] result — the bf16 weight
+        # copy never materializes.
+        codes, scale = w
+        y = (x @ codes.astype(x.dtype)) * scale.astype(x.dtype)
+    else:
+        y = x @ w.astype(x.dtype)
     if b is not None:
         y = y + b.astype(x.dtype)
     return y
@@ -283,6 +292,7 @@ def block_apply(
     cache_row_offsets: Optional[jnp.ndarray] = None,
     page_table: Optional[jnp.ndarray] = None,
     page_size: Optional[int] = None,
+    paged_decode_fn=None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """One transformer block on hidden states `h` [B, T, D].
 
@@ -316,6 +326,18 @@ def block_apply(
     so `mask_bias` must be [B, 1, T, max_pages * page_size]; sentinel
     pages gather clamped garbage that the (exactly-zero, see NEG_INF
     softmax underflow) masked probabilities never read.
+
+    In paged mode `kv_cache` may also be the int8 tier's nested form —
+    each of k/v a ``(codes, scales)`` pair from
+    :func:`init_paged_kv_cache` — in which case fresh K/V is quantized
+    per (token, head) at the scatter and dequantized at the gather.
+
+    `paged_decode_fn` (see trlx_tpu.ops.paged_attention
+    ``make_paged_decode_fn``) replaces the paged gather + attention_fn
+    when T == 1 with a fused kernel call
+    ``fn(q[:, 0], k_pages, v_pages, page_table, bias_row)`` operating
+    on the post-scatter pool; the jnp scatter (and T > 1 prefill) are
+    unchanged, keeping the jnp path as the A/B oracle.
     """
     B, T, D = h.shape
     H, hd = spec.n_head, spec.head_dim
@@ -351,7 +373,12 @@ def block_apply(
             )
         if page_size is None or page_size <= 0:
             raise ValueError(f"page_table given but page_size={page_size}")
-        k_cache, v_cache = kv_cache  # [num_pages, page_size, Hkv, hd]
+        k_entry, v_entry = kv_cache  # [num_pages, page_size, Hkv, hd]
+        quantized = isinstance(k_entry, (tuple, list))
+        if quantized:
+            (k_cache, k_sc), (v_cache, v_sc) = k_entry, v_entry
+        else:
+            k_cache, v_cache = k_entry, v_entry
         num_pages = k_cache.shape[0]
         max_pages = page_table.shape[1]
         # logical buffer position of each fresh token, then page-id
@@ -366,30 +393,51 @@ def block_apply(
             ),
             num_pages,  # out past the table: drop like a sentinel page
         )
-        k_full = k_cache.at[pids, in_off].set(
-            k.astype(k_cache.dtype), mode="drop"
-        )
-        v_full = v_cache.at[pids, in_off].set(
-            v.astype(v_cache.dtype), mode="drop"
-        )
-        new_cache = (k_full, v_full)
-        # gather-by-page AFTER the scatter: within one prefill program a
-        # row may legitimately read pages another row just wrote (the
-        # radix cache admits same-batch prefix sharers against pages
-        # whose content materializes earlier in this same program)
-        ctx_pt = jnp.clip(page_table, 0, num_pages - 1)
-        k_ctx = k_full[ctx_pt].reshape(
-            B, max_pages * page_size, Hkv, hd
-        )
-        v_ctx = v_full[ctx_pt].reshape(
-            B, max_pages * page_size, Hkv, hd
-        )
-        a = attention_fn(
-            q,
-            expand_kv(k_ctx.astype(q.dtype)),
-            expand_kv(v_ctx.astype(q.dtype)),
-            mask_bias,
-        )
+        if quantized:
+            kq, ks = quantize_kv(k)  # codes [B,T,Hkv,hd], scale [B,T,Hkv]
+            vq, vs = quantize_kv(v)
+            k_full = k_cache.at[pids, in_off].set(kq, mode="drop")
+            v_full = v_cache.at[pids, in_off].set(vq, mode="drop")
+            k_sc = k_sc.at[pids, in_off].set(ks, mode="drop")
+            v_sc = v_sc.at[pids, in_off].set(vs, mode="drop")
+            new_cache = ((k_full, k_sc), (v_full, v_sc))
+        else:
+            k_full = k_cache.at[pids, in_off].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_full = v_cache.at[pids, in_off].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            new_cache = (k_full, v_full)
+        if paged_decode_fn is not None and T == 1:
+            # fused kernel: page-table walk + online softmax in one
+            # pallas_call against the just-updated pool; bias collapses
+            # to the per-row validity lane [B, max_pages * page_size]
+            a = paged_decode_fn(
+                q[:, 0],
+                new_cache[0],
+                new_cache[1],
+                page_table,
+                mask_bias.reshape(B, -1),
+            )[:, None]
+        else:
+            # gather-by-page AFTER the scatter: within one prefill
+            # program a row may legitimately read pages another row just
+            # wrote (the radix cache admits same-batch prefix sharers
+            # against pages whose content materializes earlier in this
+            # same program)
+            ctx_pt = jnp.clip(page_table, 0, num_pages - 1)
+            if quantized:
+                k_ctx = dequantize_kv(k_full[ctx_pt], k_sc[ctx_pt], q.dtype)
+                v_ctx = dequantize_kv(v_full[ctx_pt], v_sc[ctx_pt], q.dtype)
+            else:
+                k_ctx = k_full[ctx_pt].astype(q.dtype)
+                v_ctx = v_full[ctx_pt].astype(q.dtype)
+            k_ctx = k_ctx.reshape(B, max_pages * page_size, Hkv, hd)
+            v_ctx = v_ctx.reshape(B, max_pages * page_size, Hkv, hd)
+            a = attention_fn(
+                q, expand_kv(k_ctx), expand_kv(v_ctx), mask_bias,
+            )
     elif kv_cache is not None:
         k_cache, v_cache = kv_cache
         if cache_row_offsets is not None:
@@ -525,17 +573,62 @@ def init_kv_cache(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+#: numerical floor added to int8 KV/weight scales so all-zero rows (fresh
+#: pool pages, padding) quantize to codes 0 / scale eps instead of 0/0
+KV_QUANT_EPS = 1e-8
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization of KV rows over the head_dim axis.
+
+    x [..., hd] -> (codes int8 [..., hd], scale f32 [...]): one scale
+    per (token-row, kv-head), NOT per page — decode writes one token at
+    a time into partially-filled pages, and a per-page scale would need
+    a read-modify-write requantization of every resident token on each
+    write. Per-(row, head) scales make the write a pure scatter, and
+    keep tp parity exact: under shard_map each shard sees whole heads,
+    so the scale it computes is identical to the unsharded one.
+
+    Deterministic function of content: same bits in -> same codes out,
+    which is what keeps radix prefix pages content-addressable.
+    """
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1) / 127.0 + KV_QUANT_EPS
+    codes = jnp.clip(
+        jnp.round(x32 / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_kv(codes: jnp.ndarray, scale: jnp.ndarray, dtype):
+    """Inverse of :func:`quantize_kv` (error <= scale/2 per element)."""
+    return (codes.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def init_paged_kv_cache(
     spec: ModelSpec,
     n_layers: int,
     num_pages: int,
     page_size: int,
     dtype=jnp.bfloat16,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+):
     """(k, v) page-pool buffers [L, num_pages, page_size, Hkv, hd]: one
     global pool of fixed-size KV pages shared by every slot, addressed
-    through per-slot page tables (block_apply's paged mode)."""
+    through per-slot page tables (block_apply's paged mode).
+
+    ``dtype=jnp.int8`` selects the quantized tier: each of k/v becomes a
+    ``(codes int8 [L, num_pages, page_size, Hkv, hd], scales f32
+    [L, num_pages, page_size, Hkv])`` pair (see :func:`quantize_kv`) —
+    hd bytes of codes + 4 bytes of scale per (token, head) instead of
+    2*hd bf16 bytes, so the same HBM holds ~2x the pages.
+    """
     shape = (n_layers, num_pages, page_size, spec.kv_heads, spec.head_dim)
+    if jnp.dtype(dtype) == jnp.int8:
+        sshape = shape[:-1]
+        return (
+            (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32)),
+            (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32)),
+        )
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

@@ -91,6 +91,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pages", type=int, default=None,
                    help="page-pool size under --kv-layout paged "
                         "(0 = slots x pages-per-slot capacity parity)")
+    p.add_argument("--attention", choices=("jnp", "pallas"), default=None,
+                   help="decode attention path under --kv-layout paged: "
+                        "'jnp' (default) = HBM gather + dense attention, "
+                        "the parity oracle; 'pallas' = the fused "
+                        "paged-attention kernel (page table scalar-"
+                        "prefetched, online softmax in VMEM, greedy "
+                        "bit-identical at bf16)")
+    p.add_argument("--kv-dtype", choices=("bf16", "int8"), default=None,
+                   help="KV-page storage tier under --kv-layout paged: "
+                        "'int8' stores codes + per-(token, kv-head) f32 "
+                        "scales, ~1.9x pages per GB (lossy — greedy "
+                        "parity on tested traces, not exact logits)")
+    p.add_argument("--weights-dtype", choices=("bf16", "int8"),
+                   default=None,
+                   help="serve-only weight tier: 'int8' quantizes block "
+                        "weights per output channel at strip-for-serve "
+                        "(~halves serve/model_gb; embeddings stay bf16)")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    help="TTFT objective for serve/goodput (fraction of "
                         "requests whose first token beat it; 0 = all "
@@ -160,6 +177,9 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("kv_layout", "kv_layout"),
                        ("page_size", "page_size"),
                        ("pages", "pages"),
+                       ("attention", "attention"),
+                       ("kv_dtype", "kv_dtype"),
+                       ("weights_dtype", "weights_dtype"),
                        ("slo_ttft_ms", "slo_ttft_ms"),
                        ("flight_recorder_steps", "flight_recorder_steps"),
                        ("max_replays", "max_replays"),

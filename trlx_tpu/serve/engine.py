@@ -154,6 +154,32 @@ class ServeConfig:
         small slice; ``"replicated"`` keeps each weight whole per chip —
         no all-gathers on the decode matvec path when HBM affords it
         (docs/source/serving.rst has the sizing formula).
+    :param attention: decode attention implementation under
+        ``kv_layout: paged``: ``"jnp"`` (default) gathers each slot's
+        pages back into logical order in HBM before scoring — the A/B
+        oracle and CPU fallback; ``"pallas"`` runs the fused
+        paged-attention decode kernel (trlx_tpu.ops.paged_attention):
+        page-table walk, gather, and online softmax in one pallas_call,
+        no materialized [T, hd] context. Greedy outputs are pinned
+        bit-identical between the two at bf16 KV. Off-TPU the kernel
+        runs interpreted (tier-1 coverage), so ``jnp`` is the right
+        production choice on CPU hosts.
+    :param kv_dtype: KV page-pool element tier: ``"bf16"`` (default) or
+        ``"int8"`` — symmetric per-(token, kv-head) scales quantized at
+        write time and dequantized inside the gather (fused into the
+        kernel under ``attention: pallas``). Pages shrink from
+        ``2 * head_dim`` to ``head_dim + 4`` bytes per head, so the
+        same pool HBM holds ~2x the pages; greedy outputs stay
+        parity-tested against one-shot generate() within a logit
+        tolerance rather than bit-identical. Paged layout only.
+    :param weights_dtype: serve-only weight tier applied at the
+        strip-at-load seam: ``"bf16"`` (default) installs the
+        checkpoint's dtype; ``"int8"`` quantizes the block matmul
+        weights (wq/wk/wv/wo/w_in/w_out/w_gate) to int8 codes with
+        per-output-channel f32 scales, dequantizing on the fly in the
+        matvec (the scale factors out of the contraction). Halves
+        resident block weights — the gpt-j-6B headroom knob. Embeddings,
+        lm_head, layernorms, and biases stay full precision.
     """
 
     buckets: List[List[int]] = field(
@@ -180,10 +206,55 @@ class ServeConfig:
     degrade_step_ms: float = 0.0
     mesh: Optional[Dict[str, int]] = None
     mesh_weights: str = "fsdp"
+    attention: str = "jnp"
+    kv_dtype: str = "bf16"
+    weights_dtype: str = "bf16"
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
         return cls(**filter_known_fields(cls, config or {}))
+
+
+#: block matmul leaves serve.weights_dtype: int8 quantizes — the stacked
+#: [L, in, out] matrices; biases/layernorms/embeddings stay full precision
+_QUANT_WEIGHT_LEAVES = ("wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate")
+
+
+def quantize_serve_weights(blocks):
+    """Serve-only int8 weight views: each stacked block matrix
+    [L, in, out] becomes a ``(codes int8, scale f32 [L, 1, out])`` pair
+    — symmetric per-output-channel quantization, consumed on the fly by
+    ``transformer._project`` (the scale factors out of the contraction,
+    so no bf16 weight copy ever materializes). Applied at the
+    strip-at-load seam, AFTER restore and BEFORE mesh placement, by both
+    :meth:`InferenceEngine._install_params` and
+    :meth:`InferenceEngine.strip_for_serve` so hot-swap candidates match
+    the serving tree leaf-for-leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.parallel.sharding import _path_names
+
+    def leaf(kp, x):
+        names = _path_names(kp)
+        name = names[-1] if names else ""
+        if name not in _QUANT_WEIGHT_LEAVES or getattr(x, "ndim", 0) != 3:
+            return x
+        x32 = x.astype(jnp.float32)
+        scale = (
+            jnp.max(jnp.abs(x32), axis=1, keepdims=True) / 127.0 + 1e-8
+        )
+        codes = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(
+            jnp.int8
+        )
+        return codes, scale
+
+    # is_leaf guard: already-quantized trees pass through untouched
+    return jax.tree_util.tree_map_with_path(
+        leaf, blocks,
+        is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+        and all(hasattr(m, "ndim") for m in x),
+    )
 
 
 def _normalize_buckets(buckets) -> Tuple[Bucket, ...]:
@@ -300,6 +371,35 @@ class InferenceEngine:
                 f"serve.mesh_weights '{self.serve.mesh_weights}' is not "
                 f"one of: fsdp, replicated"
             )
+        if self.serve.attention not in ("jnp", "pallas"):
+            raise ValueError(
+                f"serve.attention '{self.serve.attention}' is not one "
+                f"of: jnp, pallas"
+            )
+        if self.serve.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"serve.kv_dtype '{self.serve.kv_dtype}' is not one of: "
+                f"bf16, int8"
+            )
+        if self.serve.weights_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"serve.weights_dtype '{self.serve.weights_dtype}' is "
+                f"not one of: bf16, int8"
+            )
+        if self.serve.kv_layout != "paged":
+            if self.serve.attention == "pallas":
+                raise ValueError(
+                    "serve.attention 'pallas' is the PAGED decode "
+                    "kernel; kv_layout "
+                    f"'{self.serve.kv_layout}' has no paged pool to "
+                    "walk — use kv_layout: paged or attention: jnp"
+                )
+            if self.serve.kv_dtype != "bf16":
+                raise ValueError(
+                    "serve.kv_dtype 'int8' quantizes PAGED pool pages; "
+                    f"kv_layout '{self.serve.kv_layout}' supports bf16 "
+                    "only"
+                )
         from trlx_tpu.serve.layouts import build_serve_mesh
 
         #: the serve mesh every executable compiles against — a
@@ -474,6 +574,8 @@ class InferenceEngine:
 
         blocks = self.policy.all_blocks(params)
         embed, ln_f = self.policy.head_params_for_decode(params)
+        if self.serve.weights_dtype == "int8":
+            blocks = quantize_serve_weights(blocks)
         self.blocks, self.embed, self.ln_f = layouts.shard_decode_views(
             self.mesh, (blocks, embed, ln_f),
             weights=self.serve.mesh_weights,
@@ -517,6 +619,8 @@ class InferenceEngine:
         probe before they replace the serving set."""
         blocks = self.policy.all_blocks(params)
         embed, ln_f = self.policy.head_params_for_decode(params)
+        if self.serve.weights_dtype == "int8":
+            blocks = quantize_serve_weights(blocks)
         return blocks, embed, ln_f
 
     def validate_swap(self, views) -> None:

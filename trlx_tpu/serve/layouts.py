@@ -98,6 +98,12 @@ def decode_spec_for_leaf(path_names: Tuple[str, ...], ndim: int,
     (no gather on the matvec path). The tp split is always on.
     """
     W = "fsdp" if weights == "fsdp" else None
+    # serve-only int8 weights (serve.weights_dtype) turn matrix leaves
+    # into (codes, scale) pairs, so the key path ends in a sequence
+    # index — strip digits so the "wq"/"w_out" rules still match both
+    # members (the scale's non-dividing [L, 1, out] dims fall back per
+    # axis in _fit_spec_to_shape)
+    path_names = tuple(n for n in path_names if not n.isdigit())
     name = path_names[-1] if path_names else ""
     parent = path_names[-2] if len(path_names) > 1 else ""
 
@@ -145,7 +151,15 @@ def kv_pool_shardings(mesh: Mesh, pool: Any) -> Any:
     ShapeDtypeStructs; an Hkv that tp doesn't divide replicates."""
 
     def leaf(x):
-        spec = KV_POOL_SPEC if getattr(x, "ndim", 0) == 5 else P()
+        nd = getattr(x, "ndim", 0)
+        if nd == 5:
+            spec = KV_POOL_SPEC
+        elif nd == 4:
+            # int8 tier scale planes [L, num_pages, page_size, Hkv]:
+            # same head split as the codes they scale
+            spec = P(None, None, None, "tp")
+        else:
+            spec = P()
         spec = _fit_spec_to_shape(spec, x.shape, mesh)
         return NamedSharding(mesh, spec)
 

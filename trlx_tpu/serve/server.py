@@ -560,6 +560,20 @@ class InferenceServer:
             telemetry.set_gauge("serve/goodput", 0.0)
         if self.engine.serve.scheduler == "slots":
             telemetry.set_gauge("serve/slot_occupancy", 0.0)
+            # quantization tier, visible per scrape: bytes one committed
+            # token holds resident, and the KV element width in bits
+            # (16 = bf16, 8 = int8) — the numeric twin of /healthz's
+            # ``kv.kv_dtype`` string
+            from trlx_tpu.telemetry.flops import kv_bytes_per_token
+
+            kv_dtype = self.engine.serve.kv_dtype
+            telemetry.set_gauge(
+                "serve/kv_bytes_per_token",
+                kv_bytes_per_token(self.engine.spec, kv_dtype),
+            )
+            telemetry.set_gauge(
+                "serve/kv_dtype", 8 if kv_dtype == "int8" else 16
+            )
             cache = getattr(self.batcher, "cache", None)
             if cache is not None:  # paged pool health, scraped from 0
                 telemetry.set_gauge(

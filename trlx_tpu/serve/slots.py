@@ -102,6 +102,7 @@ class SlotPoolRuntime:
         import functools
 
         import jax
+        import jax.numpy as jnp
 
         from trlx_tpu.models.generation import (
             _segments_of,
@@ -130,9 +131,17 @@ class SlotPoolRuntime:
             self.num_pages = engine.page_count()
             # logical per-slot extent rounds UP to whole pages
             self.buffer_len = self.max_pages * self.page_size
+            # serve.kv_dtype picks the pool tier: int8 swaps each (k, v)
+            # array for (codes, scales) pairs (transformer.quantize_kv);
+            # everything downstream — shardings, prefill/decode, reset —
+            # flows from this partial, so the tier is set exactly once
+            cache_dtype = (
+                jnp.int8 if engine.serve.kv_dtype == "int8"
+                else jnp.bfloat16
+            )
             self._init_pool = functools.partial(
                 init_page_pool, engine.spec, self._seg_sizes,
-                self.num_pages, self.page_size,
+                self.num_pages, self.page_size, cache_dtype=cache_dtype,
             )
         else:
             self.page_size = self.max_pages = self.num_pages = 0
@@ -238,10 +247,30 @@ class SlotPoolRuntime:
             cfg = self.engine._gen_base
             compute = self.engine._compute_dtype
 
+            # serve.attention: pallas swaps the paged gather+score for
+            # the fused decode kernel; shard_map'd when the mesh spans
+            # devices so tp head-sharding (and greedy parity) holds.
+            # Prefill stays jnp either way — the kernel is decode-only.
+            paged_decode_fn = None
+            if (
+                self.kv_layout == "paged"
+                and self.engine.serve.attention == "pallas"
+            ):
+                from trlx_tpu.ops.paged_attention import (
+                    make_paged_decode_fn,
+                )
+                from trlx_tpu.serve import layouts
+
+                paged_decode_fn = make_paged_decode_fn(
+                    None if layouts.is_single_device(self.mesh)
+                    else self.mesh
+                )
+
             def run(blocks, embed, ln_f, pool, state, seed):
                 return decode_step(
                     spec, blocks, embed, ln_f, pool, state, seed, cfg,
                     compute_dtype=compute,
+                    paged_decode_fn=paged_decode_fn,
                 )
 
             self._step_fn = aot_jit(
@@ -843,8 +872,15 @@ class SlotScheduler:
         bytes over tp while page counts stay global."""
         from trlx_tpu.serve import layouts
 
+        from trlx_tpu.telemetry.flops import kv_bytes_per_token
+
+        kv_dtype = self.engine.serve.kv_dtype
         stats = {
             "kv_layout": self.runtime.kv_layout,
+            "kv_dtype": kv_dtype,
+            "kv_bytes_per_token": kv_bytes_per_token(
+                self.engine.spec, kv_dtype
+            ),
             "slots": self.runtime.num_slots,
             "pool_gb_per_device": round(
                 layouts.tree_bytes_per_device(self.runtime.pool) / 2**30,

@@ -52,6 +52,22 @@ def decode_flops_per_token(spec) -> int:
     return L * 2 * (4 * d * d + 2 * d * f) + 2 * d * V
 
 
+def kv_bytes_per_token(spec, kv_dtype: str = "bf16") -> int:
+    """Resident KV-pool bytes one committed token costs, by tier.
+
+    ``bf16``: k+v, each ``head_dim`` 2-byte elements per kv-head per
+    layer. ``int8`` (serve.kv_dtype): ``head_dim`` 1-byte codes plus one
+    f32 scale per (token, kv-head) — the quantize_kv layout. The single
+    source of truth for pool sizing: slots.pool_stats, the
+    ``serve/kv_bytes_per_token`` gauge, and bench.py's slots-per-GB /
+    HBM-precheck accounting all read this.
+    """
+    per_head = (
+        spec.head_dim + 4 if kv_dtype == "int8" else 2 * spec.head_dim
+    )
+    return 2 * spec.n_layer * spec.kv_heads * per_head
+
+
 def ilql_train_flops_per_token(
     spec, num_layers_unfrozen: int, two_qs: bool = True
 ) -> int:
