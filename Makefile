@@ -8,9 +8,12 @@
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
-# the catalog. No baseline file — HEAD is always clean.
+# the catalog. No baseline file — HEAD is always clean. --budget asserts
+# the walltime contract (whole repo incl. the concurrency tier's thread
+# model in < 10 s) so lint stays cheap enough to gate every commit;
+# `--changed-only <ref>` is the pre-commit fast path.
 lint:
-	python -m trlx_tpu.analysis
+	python -m trlx_tpu.analysis --budget 10
 
 check: lint kernels
 	@command -v ruff >/dev/null 2>&1 \

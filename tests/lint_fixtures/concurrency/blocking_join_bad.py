@@ -1,0 +1,28 @@
+"""blocking-under-shared-lock: stop() joins the worker (unbounded)
+while holding the lock the watchdog thread also takes for its beat —
+a slow worker parks the liveness probe on the lock."""
+
+import threading
+
+
+class Reaper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._work, daemon=True)
+
+    def start(self):
+        self._worker.start()
+        threading.Thread(
+            target=self._watch, name="reaper-watchdog", daemon=True
+        ).start()
+
+    def _work(self):
+        pass
+
+    def _watch(self):
+        with self._lock:
+            pass
+
+    def stop(self):
+        with self._lock:
+            self._worker.join()

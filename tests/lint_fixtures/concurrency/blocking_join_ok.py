@@ -1,0 +1,29 @@
+"""Clean twin of blocking_join_bad: copy the handle under the lock,
+release, then block on the local — the watchdog never waits behind a
+slow worker."""
+
+import threading
+
+
+class Reaper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._work, daemon=True)
+
+    def start(self):
+        self._worker.start()
+        threading.Thread(
+            target=self._watch, name="reaper-watchdog", daemon=True
+        ).start()
+
+    def _work(self):
+        pass
+
+    def _watch(self):
+        with self._lock:
+            pass
+
+    def stop(self):
+        with self._lock:
+            worker = self._worker
+        worker.join()

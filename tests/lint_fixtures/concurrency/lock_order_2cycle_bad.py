@@ -1,0 +1,30 @@
+"""lock-order-cycle, the classic 2-lock inversion: the forward thread
+nests a under b, the reverse thread nests b under a. Each nest is fine
+alone; the cycle across the two contexts deadlocks the first time the
+schedules interleave."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def start(self):
+        threading.Thread(
+            target=self._fwd, name="pair-fwd", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._rev, name="pair-rev", daemon=True
+        ).start()
+
+    def _fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def _rev(self):
+        with self._b:
+            with self._a:
+                pass

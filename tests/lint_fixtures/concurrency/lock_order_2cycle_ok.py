@@ -1,0 +1,28 @@
+"""Clean twin of lock_order_2cycle_bad: both threads agree on the
+global order a-then-b, so the lock-order graph is acyclic."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def start(self):
+        threading.Thread(
+            target=self._fwd, name="pair-fwd", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._rev, name="pair-rev", daemon=True
+        ).start()
+
+    def _fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def _rev(self):
+        with self._a:
+            with self._b:
+                pass

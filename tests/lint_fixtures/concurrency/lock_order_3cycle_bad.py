@@ -1,0 +1,42 @@
+"""lock-order-cycle through three locks and a helper call: a->b and
+b->c are lexical nests; the closing c->a edge only exists because
+_close() is CALLED while _c is held and transitively acquires _a —
+the interprocedural edge the lexical checker cannot draw."""
+
+import threading
+
+
+class Trio:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def start(self):
+        threading.Thread(
+            target=self._one, name="trio-one", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._two, name="trio-two", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._three, name="trio-three", daemon=True
+        ).start()
+
+    def _one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def _two(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def _three(self):
+        with self._c:
+            self._close()
+
+    def _close(self):
+        with self._a:
+            pass

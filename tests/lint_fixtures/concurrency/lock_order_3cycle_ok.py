@@ -1,0 +1,42 @@
+"""Clean twin of lock_order_3cycle_bad: the third thread releases _c
+before calling the helper that takes _a, so every edge respects the
+one global order a -> b -> c."""
+
+import threading
+
+
+class Trio:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def start(self):
+        threading.Thread(
+            target=self._one, name="trio-one", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._two, name="trio-two", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._three, name="trio-three", daemon=True
+        ).start()
+
+    def _one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def _two(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def _three(self):
+        with self._c:
+            pass
+        self._close()
+
+    def _close(self):
+        with self._a:
+            pass

@@ -1,0 +1,30 @@
+"""race-detected via a broken '# holds:' contract: _append_locked
+declares its caller must hold _lock; the flusher thread calls it bare.
+The write itself is contract-clean (holds_on covers it) — the bug is at
+the CALL SITE, which only interprocedural lockset propagation sees."""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []  # guarded-by: _lock
+
+    def start(self):
+        threading.Thread(
+            target=self._writer, name="journal-writer", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._flusher, name="journal-flusher", daemon=True
+        ).start()
+
+    def _writer(self):
+        with self._lock:
+            self._append_locked("tick")
+
+    def _flusher(self):
+        self._append_locked("flush")
+
+    def _append_locked(self, item):  # holds: _lock
+        self._entries.append(item)

@@ -1,0 +1,29 @@
+"""race-detected via a helper call: both threads reach _bump(), which
+writes guarded state with no lock — invisible to the lexical guarded-by
+rule only if the write were in another class; here the THREAD MODEL is
+what proves two contexts reach it."""
+
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}  # guarded-by: _lock
+
+    def start(self):
+        threading.Thread(
+            target=self._drain, name="tally-drain", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._ingest, name="tally-ingest", daemon=True
+        ).start()
+
+    def _drain(self):
+        self._bump("drained")
+
+    def _ingest(self):
+        self._bump("ingested")
+
+    def _bump(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1

@@ -1,0 +1,28 @@
+"""Clean twin of race_helper_bad: the shared helper takes the guard, so
+the lockset at the write is non-empty on both thread contexts."""
+
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}  # guarded-by: _lock
+
+    def start(self):
+        threading.Thread(
+            target=self._drain, name="tally-drain", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._ingest, name="tally-ingest", daemon=True
+        ).start()
+
+    def _drain(self):
+        self._bump("drained")
+
+    def _ingest(self):
+        self._bump("ingested")
+
+    def _bump(self, key):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1

@@ -1,0 +1,19 @@
+"""signal-unsafe-call: the SIGTERM handler acquires a non-reentrant
+Lock — if the signal lands while the interrupted frame holds it, the
+process self-deadlocks with no second thread involved."""
+
+import signal
+import threading
+
+
+class Flagger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0  # guarded-by: _lock
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        with self._lock:
+            self._hits += 1

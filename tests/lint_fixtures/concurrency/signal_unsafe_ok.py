@@ -1,0 +1,22 @@
+"""Clean twin of signal_unsafe_bad, showing both vetted handler
+shapes: set an Event a poll loop consumes, and count under an RLock
+(re-entry from the interrupted frame is a no-op, the MetricsRegistry
+pattern)."""
+
+import signal
+import threading
+
+
+class Flagger:
+    def __init__(self):
+        self._rlock = threading.RLock()
+        self._hits = 0  # guarded-by: _rlock
+        self._flag = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._flag.set()
+        with self._rlock:
+            self._hits += 1

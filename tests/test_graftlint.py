@@ -16,7 +16,7 @@ import sys
 
 import pytest
 
-from trlx_tpu.analysis import RULES, run_rules
+from trlx_tpu.analysis import RULES, run_lint, run_rules
 from trlx_tpu.analysis.model import OBSERVABILITY_DOC, ProjectModel
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -58,6 +58,12 @@ SIMPLE = [
     ("guarded-by-unknown", "locks/guarded_by_unknown", LIB),
     ("metric-dynamic-name", "contracts/metric_dynamic_name", LIB),
     ("http-timeout-required", "contracts/http_timeout_required", LIB),
+    ("race-detected", "concurrency/race_helper", LIB),
+    ("race-detected", "concurrency/race_contract", LIB),
+    ("lock-order-cycle", "concurrency/lock_order_2cycle", LIB),
+    ("lock-order-cycle", "concurrency/lock_order_3cycle", LIB),
+    ("blocking-under-shared-lock", "concurrency/blocking_join", LIB),
+    ("signal-unsafe-call", "concurrency/signal_unsafe", LIB),
 ]
 
 
@@ -261,12 +267,242 @@ def test_bad_suppression_cannot_suppress_itself():
 
 def test_rule_catalog_metadata_is_complete():
     run_rules(ProjectModel(files={}))  # force rule registration
-    assert len(RULES) >= 20
+    assert len(RULES) >= 26
     assert {r.family for r in RULES.values()} == {
-        "style", "jax", "locks", "contracts",
+        "style", "jax", "locks", "contracts", "concurrency",
     }
     for rule in RULES.values():
         assert rule.id and rule.family and rule.rationale and rule.hint
+
+
+# --------------------------------------------------------------------- #
+# the concurrency tier: thread model + whole-program engines
+# --------------------------------------------------------------------- #
+
+def project(files, docs=None):
+    return ProjectModel(files=files, docs=docs)
+
+
+def test_thread_model_finds_spawn_roots_and_propagates_contexts():
+    """Thread(target=...) spawns become roots named by their literal
+    name= kwarg, and the call-graph walk carries both contexts into the
+    shared helper."""
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    tm = thread_model(project(
+        {LIB: fixture("concurrency/race_helper_bad.py")}
+    ))
+    assert {"tally-drain", "tally-ingest"} <= set(tm.roots)
+    bump = tm.functions[f"{LIB}::Tally._bump"]
+    assert bump.contexts == {"tally-drain", "tally-ingest"}
+    # the spawner itself runs on no modeled root (main thread is not a
+    # root: single-context code cannot race with itself)
+    start = tm.functions[f"{LIB}::Tally.start"]
+    assert start.contexts == set()
+
+
+_HTTP_SIGNAL_SRC = '''\
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # guarded-by: _lock
+
+    @property
+    def ready(self):
+        with self._lock:
+            return bool(self._state)
+
+    def on_term(self, signum, frame):
+        pass
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self.on_term)
+
+
+class Handler(BaseHTTPRequestHandler):
+    server_ref: "Server" = None
+
+    def do_GET(self):
+        srv = self.server_ref
+        if srv.ready:
+            pass
+'''
+
+
+def test_thread_model_http_signal_roots_and_property_edges():
+    """Every do_* of a BaseHTTPRequestHandler subclass is a pool-entry
+    root; signal.signal installs a signal root; a property READ through
+    a typed class attribute is a call edge (srv.ready runs code)."""
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    tm = thread_model(project({LIB: _HTTP_SIGNAL_SRC}))
+    assert "http:Handler.do_GET" in tm.roots
+    assert "signal:SIGTERM" in tm.roots
+    ready = tm.functions[f"{LIB}::Server.ready"]
+    assert "http:Handler.do_GET" in ready.contexts
+    on_term = tm.functions[f"{LIB}::Server.on_term"]
+    assert on_term.contexts == {"signal:SIGTERM"}
+
+
+def test_thread_model_lockset_tracks_holds_contract_and_nesting():
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    tm = thread_model(project(
+        {LIB: fixture("concurrency/race_contract_bad.py")}
+    ))
+    appender = tm.functions[f"{LIB}::Journal._append_locked"]
+    assert appender.entry_locks == {"Journal._lock"}
+    # and the lexical nest in _writer covers its call site
+    writer = tm.functions[f"{LIB}::Journal._writer"]
+    (callee, _, held), = [
+        c for c in writer.calls if c[0].endswith("_append_locked")
+    ]
+    assert held == {"Journal._lock"}
+
+
+def test_thread_model_lock_order_graph_has_interprocedural_edges():
+    """The 3-cycle fixture's closing edge (c -> a) exists only through
+    a call made while holding _c."""
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    tm = thread_model(project(
+        {LIB: fixture("concurrency/lock_order_3cycle_bad.py")}
+    ))
+    assert ("Trio._c", "Trio._a") in tm.lock_edges
+    assert tm.lock_cycles() == [["Trio._a", "Trio._b", "Trio._c"]]
+
+
+def test_thread_model_is_cached_on_the_project():
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    p = project({LIB: "x = 1\n"})
+    assert thread_model(p) is thread_model(p)
+
+
+def test_real_serve_thread_inventory_is_modeled():
+    """The whole-repo model sees the real serving threads — the roots
+    docs/source/static_analysis.rst inventories. A rename here is a
+    docs-and-model update, not a silent hole."""
+    from trlx_tpu.analysis.concurrency import thread_model
+
+    _, proj = run_lint(root=REPO, select=["race-detected"])
+    tm = thread_model(proj)
+    expected = {
+        "trlx-serve-slots", "trlx-serve-drain", "trlx-serve-watch",
+        "trlx-router-probe", "trlx-watchdog", "signal:SIGTERM",
+    }
+    assert expected <= set(tm.roots), sorted(tm.roots)
+    report = tm.report()
+    for label in expected:
+        assert f"[{label}]" in report
+
+
+# --------------------------------------------------------------------- #
+# CLI satellites: sarif, --threads, --changed-only, --budget
+# --------------------------------------------------------------------- #
+
+def _cli(*argv, cwd=None):
+    cmd = [sys.executable, "-m", "trlx_tpu.analysis", *argv]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=cwd or REPO)
+
+
+def _tmp_repo(tmp_path, bad=True):
+    lib = tmp_path / "trlx_tpu"
+    lib.mkdir()
+    stem = "none_comparison_bad" if bad else "none_comparison_ok"
+    (lib / "mod.py").write_text(fixture(f"style/{stem}.py"))
+    return tmp_path
+
+
+def test_cli_sarif_shape(tmp_path):
+    """SARIF 2.1.0: the JSON shape CI annotators rely on is pinned —
+    version, driver name + rule catalog, ruleId/level/message and a
+    physicalLocation with uri + startLine per result."""
+    import json
+
+    root = _tmp_repo(tmp_path, bad=True)
+    out = _cli(str(root), "--format", "sarif")
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "race-detected" in rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "none-comparison"
+    assert res["level"] == "error"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "trlx_tpu/mod.py"
+    assert loc["region"]["startLine"] > 0
+
+    clean_root = tmp_path / "c"
+    clean_root.mkdir()
+    clean = _cli(str(_tmp_repo(clean_root, bad=False)),
+                 "--format", "sarif")
+    assert clean.returncode == 0
+    assert json.loads(clean.stdout)["runs"][0]["results"] == []
+
+
+def test_cli_threads_report(tmp_path):
+    root = tmp_path
+    lib = root / "trlx_tpu"
+    lib.mkdir()
+    (lib / "mod.py").write_text(
+        fixture("concurrency/race_helper_ok.py")
+    )
+    out = _cli(str(root), "--threads")
+    assert out.returncode == 0
+    assert "[tally-drain]" in out.stdout
+    assert "[tally-ingest]" in out.stdout
+    assert "Tally._bump" in out.stdout
+    assert "Tally._lock" in out.stdout
+
+
+def test_cli_changed_only_lints_just_the_diff(tmp_path):
+    """--changed-only reports findings only in files changed vs the
+    ref; the model (and so cross-file rules) stays whole-repo."""
+    root = _tmp_repo(tmp_path, bad=True)
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             *args],
+            capture_output=True, text=True, cwd=root,
+        )
+
+    assert git("init", "-q").returncode == 0
+    git("add", "-A")
+    assert git("commit", "-qm", "seed").returncode == 0
+    # the committed file is bad, but it is not part of the diff
+    (root / "trlx_tpu" / "fresh.py").write_text(
+        fixture("style/bare_except_bad.py")
+    )
+    out = _cli(str(root), "--changed-only", "HEAD")
+    assert out.returncode == 1
+    assert "fresh.py" in out.stdout
+    assert "mod.py" not in out.stdout
+    assert "changed vs HEAD" in out.stdout
+
+    bad_ref = _cli(str(root), "--changed-only", "no-such-ref")
+    assert bad_ref.returncode == 2
+    assert "no-such-ref" in bad_ref.stderr
+
+
+def test_cli_budget_fails_a_slow_run(tmp_path):
+    root = _tmp_repo(tmp_path, bad=False)
+    ok = _cli(str(root), "--budget", "60")
+    assert ok.returncode == 0
+    slow = _cli(str(root), "--budget", "0.000001")
+    assert slow.returncode == 1
+    assert "budget exceeded" in slow.stderr
 
 
 def test_unknown_select_is_a_loud_error():

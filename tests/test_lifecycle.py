@@ -374,6 +374,53 @@ def test_retry_after_paces_the_backlog(http_engine):
         telemetry.start()
 
 
+def test_sigterm_handler_only_sets_the_event(http_engine):
+    """Regression (graftlint signal-unsafe-call): the SIGTERM handler
+    used to call begin_drain() directly — taking the non-reentrant
+    _lifecycle_lock and constructing the drain thread INSIDE the
+    handler. A SIGTERM landing while the interrupted frame was already
+    inside begin_drain() (Ctrl-C racing /admin/drain) self-deadlocked
+    with no second thread involved. Now the handler only sets
+    _drain_requested: this drill reproduces the interleaving by
+    delivering the handler while _lifecycle_lock is held and requires
+    it to return immediately, flip admission at once, and leave the
+    actual drain to serve_forever's poll loop."""
+    telemetry.start()
+    srv = InferenceServer(http_engine, port=0).start(warmup=True)
+    try:
+        delivered = threading.Event()
+
+        def deliver():
+            srv._on_sigterm(signal.SIGTERM, None)
+            delivered.set()
+
+        with srv._lifecycle_lock:  # the frame the signal interrupted
+            threading.Thread(target=deliver, daemon=True).start()
+            assert delivered.wait(timeout=5.0), \
+                "_on_sigterm blocked on _lifecycle_lock"
+            assert srv._drain_requested.is_set()
+        # no drain thread from the handler — starting it is the poll
+        # loop's job — but admission flips from the signal alone
+        with srv._lifecycle_lock:
+            assert srv._drain_thread is None
+        assert srv.draining is True
+        status, _, body = _http(srv.port, "/readyz")
+        assert status == 503 and body["draining"] is True
+        status, _, _ = _http(srv.port, "/healthz")
+        assert status == 200, "liveness must survive the window"
+        # the poll loop's half, inlined: begin_drain consumes the flag
+        srv.begin_drain()
+        srv._drain_requested.clear()
+        assert srv._drain_done.wait(timeout=60.0)
+        assert srv._drain_clean is True
+    finally:
+        try:
+            srv.stop()
+        except RuntimeError:
+            pass
+        telemetry.start()
+
+
 def test_hot_swap_under_load_e2e(tmp_path):
     """Live reload mid-burst: the endpoint NEVER refuses connections,
     in-flight requests finish on their admitted version, the swap lands

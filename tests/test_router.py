@@ -189,6 +189,71 @@ def test_affinity_index_lru_cap():
     assert len(idx) <= 8
 
 
+def test_prober_and_route_handlers_share_the_affinity_lock():
+    """Regression (graftlint race-detected): AffinityIndex is NOT
+    thread-safe on its own — the prober's ejection path
+    (drop_backend iterates the entry dict), the route handlers'
+    match/insert/decay, and /fleet's len() must all go through
+    FleetRouter._lock, which the ``# guarded-by: _lock`` annotation now
+    makes a proof obligation. This drill reproduces the
+    prober-vs-handler interleaving in-process: an unguarded
+    drop_backend against concurrent inserts dies with 'dictionary
+    changed size during iteration' or tears an entry."""
+    telemetry.start()
+    router = FleetRouter(RouterConfig(
+        backends=["127.0.0.1:1", "127.0.0.1:2"],
+        port=0, page_size=2,
+    ))
+    b1, b2 = router.backends
+    for b in router.backends:
+        b.admitted = True
+        b.ever_admitted = True
+    rows = [[i] * 9 for i in range(8)]  # 4 committed blocks each
+    errors = []
+
+    def prober():
+        # ready/not-ready flapping ejects + re-admits b2: every
+        # ejection runs affinity.drop_backend against the handlers'
+        # concurrent inserts
+        try:
+            for i in range(200):
+                router._apply_probe(b2, i % 2 == 1, 1,
+                                    {"queue_depth": 0})
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def handler(seed):
+        try:
+            for i in range(300):
+                key = rows[(i + seed) % len(rows)]
+                backend, depth, how = router._pick(key, exclude=())
+                if backend is None:
+                    continue
+                router._note_routed(
+                    backend, key, depth, how, 200,
+                    {"trace": {"prefix_blocks_hit": 1}},
+                )
+                router.fleet_state()
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=prober, daemon=True)] + [
+        threading.Thread(target=handler, args=(s,), daemon=True)
+        for s in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "drill wedged"
+    assert not errors, errors
+    # structurally intact after the churn: every surviving entry still
+    # points at a fleet member
+    with router._lock:
+        owners = {id(v[0]) for v in router.affinity._entries.values()}
+    assert owners <= {id(b1), id(b2)}
+
+
 def test_router_config_validation():
     with pytest.raises(ValueError, match="at least one replica"):
         RouterConfig(backends=[])

@@ -1,14 +1,102 @@
 """CLI: ``python -m trlx_tpu.analysis [root] [--select a,b] [...]``.
 
-Exit status 0 = clean, 1 = findings, 2 = usage error. Deliberately
-free of jax/numpy imports so ``make lint`` stays a sub-second pure-AST
-pass.
+Exit status 0 = clean, 1 = findings (or a blown ``--budget``),
+2 = usage error. Deliberately free of jax/numpy imports so ``make
+lint`` stays a fast pure-AST pass.
+
+Output modes: the default text format (one finding per line + fix
+hint), ``--format sarif`` (SARIF 2.1.0 JSON on stdout, for CI PR
+annotation), and ``--threads`` (the computed whole-program thread
+model: root -> reachable functions -> locks touched — the reviewable
+inventory docs/source/static_analysis.rst snapshots).
+
+``--changed-only <git-ref>`` keeps the MODEL whole-repo (cross-file
+rules — chaos registry sync, kernel parity, thread contexts — stay
+sound) but reports only findings in files changed vs the ref, for
+pre-commit use. ``--budget <seconds>`` makes the run fail when it
+exceeds its own walltime budget, so `make lint` can assert the
+<10 s contract instead of silently rotting.
 """
 
 import argparse
+import json
+import subprocess
 import sys
+import time
 
 from trlx_tpu.analysis import RULES, _load_rules, run_lint
+
+#: SARIF severity for graftlint findings: every rule is build-blocking
+#: (exit 1), so every result is level "error"
+_SARIF_LEVEL = "error"
+
+
+def _sarif(findings, rules) -> dict:
+    """SARIF 2.1.0: the minimal shape CI annotators consume — driver
+    name + rule catalog, one result per finding with ruleId, level,
+    message and a physicalLocation (uri + startLine)."""
+    return {
+        "version": "2.1.0",
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": (
+                    "docs/source/static_analysis.rst"
+                ),
+                "rules": [
+                    {
+                        "id": r.id,
+                        "shortDescription": {"text": r.rationale},
+                        "help": {"text": r.hint},
+                    }
+                    for r in sorted(rules.values(), key=lambda r: r.id)
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": _SARIF_LEVEL,
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.file},
+                            "region": {"startLine": f.line},
+                        },
+                    }],
+                }
+                for f in findings
+            ],
+        }],
+    }
+
+
+def _changed_files(root, ref: str):
+    """Repo-relative paths changed vs ``ref`` plus untracked files, or
+    None when git cannot answer (caller turns that into exit 2)."""
+    cwd = root if root is not None else None
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            capture_output=True, text=True, cwd=cwd,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=cwd,
+        )
+    except OSError:
+        return None
+    out = {p.strip() for p in diff.stdout.splitlines() if p.strip()}
+    if untracked.returncode == 0:
+        out.update(
+            p.strip() for p in untracked.stdout.splitlines() if p.strip()
+        )
+    return out
 
 
 def main(argv=None) -> int:
@@ -22,7 +110,20 @@ def main(argv=None) -> int:
                     help="run only these rule ids")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "sarif"), dest="fmt",
+                    help="finding output format (default: text)")
+    ap.add_argument("--threads", action="store_true",
+                    help="print the computed thread model and exit")
+    ap.add_argument("--changed-only", default=None, metavar="GIT_REF",
+                    help="report findings only in files changed vs the "
+                         "ref (model still built whole-repo)")
+    ap.add_argument("--budget", type=float, default=None,
+                    metavar="SECONDS",
+                    help="fail (exit 1) when the run exceeds this "
+                         "walltime")
     args = ap.parse_args(argv)
+    started = time.monotonic()
 
     if args.list_rules:
         _load_rules()
@@ -35,6 +136,15 @@ def main(argv=None) -> int:
             print(f"  {rule.id:24s} {rule.rationale.split(';')[0]}")
         return 0
 
+    changed = None
+    if args.changed_only is not None:
+        changed = _changed_files(args.root, args.changed_only)
+        if changed is None:
+            print(f"error: git diff --name-only {args.changed_only} "
+                  f"failed (not a repo, or unknown ref)",
+                  file=sys.stderr)
+            return 2
+
     select = None
     if args.select:
         select = [s.strip() for s in args.select.split(",") if s.strip()]
@@ -44,15 +154,45 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    if args.threads:
+        from trlx_tpu.analysis.concurrency import thread_model
+
+        print(thread_model(project).report())
+        return 0
+
+    if changed is not None:
+        findings = [f for f in findings if f.file in changed]
+
+    if args.fmt == "sarif":
+        json.dump(_sarif(findings, RULES), sys.stdout, indent=2)
+        print()
+        return 1 if findings else 0
+
     for f in findings:
         print(f.render())
     nfiles = len(project.files)
+    status = 0
     if findings:
         bad = len({f.file for f in findings})
-        print(f"\n{len(findings)} finding(s) in {bad} of {nfiles} files")
-        return 1
-    print(f"clean: {nfiles} files, {len(RULES)} rules")
-    return 0
+        scope = f" (changed vs {args.changed_only})" if changed else ""
+        print(f"\n{len(findings)} finding(s) in {bad} of {nfiles} "
+              f"files{scope}")
+        status = 1
+    else:
+        scope = ""
+        if changed is not None:
+            in_model = len({p for p in changed if p in project.files})
+            scope = (f" ({in_model} changed vs {args.changed_only} "
+                     f"reported)")
+        print(f"clean: {nfiles} files, {len(RULES)} rules{scope}")
+    if args.budget is not None:
+        elapsed = time.monotonic() - started
+        if elapsed > args.budget:
+            print(f"budget exceeded: {elapsed:.1f}s > "
+                  f"{args.budget:.1f}s — lint must stay fast enough "
+                  f"to run on every commit", file=sys.stderr)
+            status = max(status, 1)
+    return status
 
 
 if __name__ == "__main__":

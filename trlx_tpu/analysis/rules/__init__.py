@@ -1,6 +1,7 @@
 """Rule families. Importing this package registers every rule."""
 
 from trlx_tpu.analysis.rules import (  # noqa: F401  (register on import)
+    concurrency,
     contracts,
     jax_hazards,
     locks,

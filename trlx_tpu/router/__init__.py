@@ -378,7 +378,10 @@ class FleetRouter:
     def __init__(self, config: RouterConfig):
         self.config = config
         self.backends = [Backend(spec) for spec in config.backends]
-        self.affinity = AffinityIndex(
+        # prefix->backend placement state; the prober (drop_backend on
+        # eviction), route handlers (match/insert/decay) and /fleet all
+        # reach it, so every touch — reads included — goes through _lock
+        self.affinity = AffinityIndex(  # guarded-by: _lock
             config.page_size, max_entries=config.affinity_entries
         )
         #: membership + affinity + goodput tallies; every Backend field

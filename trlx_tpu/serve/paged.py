@@ -63,7 +63,10 @@ class PageAllocator:
         self._ref: List[int] = [0] * num_pages  # guarded-by: _lock
 
     def free_count(self) -> int:
-        return len(self._free)
+        # read under the lock: /healthz and the drain path call this
+        # from off-worker threads while alloc/free resize the list
+        with self._lock:
+            return len(self._free)
 
     def refcount(self, page: int) -> int:
         return self._ref[page]

@@ -374,6 +374,12 @@ class InferenceServer:
         # -- crash-only lifecycle (docs "Fault tolerance") -------------- #
         self._lifecycle_lock = threading.Lock()
         self._drain_thread: Optional[threading.Thread] = None  # guarded-by: _lifecycle_lock
+        # SIGTERM sets this; serve_forever's poll loop runs the actual
+        # begin_drain(). The handler itself may not take _lifecycle_lock
+        # (non-reentrant: a SIGTERM landing while the interrupted frame
+        # holds it — e.g. Ctrl-C racing /admin/drain — self-deadlocks)
+        # nor construct the drain thread.
+        self._drain_requested = threading.Event()
         self._drain_done = threading.Event()
         self._drain_clean = False
         self._watch_stop = threading.Event()
@@ -383,9 +389,14 @@ class InferenceServer:
     @property
     def draining(self) -> bool:
         """Admission state for /readyz: True once a drain has begun
-        (SIGTERM or POST /admin/drain), from the moment of entry."""
-        return self._drain_thread is not None \
-            or bool(getattr(self.batcher, "_draining", False))
+        (SIGTERM or POST /admin/drain), from the moment of entry —
+        including the window between SIGTERM landing and the poll loop
+        starting the drain thread."""
+        if self._drain_requested.is_set():
+            return True
+        with self._lifecycle_lock:
+            started = self._drain_thread is not None
+        return started or bool(getattr(self.batcher, "_draining", False))
 
     @property
     def warmed(self) -> bool:
@@ -659,11 +670,13 @@ class InferenceServer:
         self.batcher.stop()
 
     def _on_sigterm(self, signum, frame) -> None:
-        # runs between bytecodes on the main thread: must return fast —
-        # the actual drain happens on the background drain thread
-        print("[trlx_tpu.serve] SIGTERM: beginning graceful drain",
-              file=sys.stderr, flush=True)
-        self.begin_drain()
+        # runs between bytecodes on whatever frame the signal interrupts:
+        # Event.set() only. begin_drain() takes the non-reentrant
+        # _lifecycle_lock and builds a Thread — if SIGTERM lands while
+        # the interrupted frame is inside begin_drain() (Ctrl-C racing
+        # /admin/drain), doing that here self-deadlocks. The poll loop
+        # in serve_forever picks the request up within a second.
+        self._drain_requested.set()
 
     def serve_forever(self) -> None:
         """Block the calling thread until the server drains (the CLI's
@@ -679,7 +692,13 @@ class InferenceServer:
                   file=sys.stderr, flush=True)
         try:
             while not self._drain_done.wait(timeout=1.0):
-                continue
+                if self._drain_requested.is_set():
+                    print("[trlx_tpu.serve] SIGTERM: beginning graceful "
+                          "drain", file=sys.stderr, flush=True)
+                    # start the drain FIRST, then clear, so `draining`
+                    # (request-set OR thread-started) never flickers off
+                    self.begin_drain()
+                    self._drain_requested.clear()
         except KeyboardInterrupt:
             print("[trlx_tpu.serve] interrupted; beginning graceful drain",
                   file=sys.stderr, flush=True)

@@ -531,7 +531,10 @@ class SlotScheduler:
     # -- submission ------------------------------------------------------- #
 
     def queue_depth(self) -> int:
-        return len(self._queue)
+        # under the cond: /healthz, admission 429s and the drain path
+        # ask from off-worker threads while submit/admit mutate it
+        with self._cond:
+            return len(self._queue)
 
     def free_slots(self) -> int:
         return len(self._free)
@@ -1102,7 +1105,8 @@ class SlotScheduler:
             self._drain_expire()
         else:
             self._drained.wait(timeout=float(timeout) + 10.0)
-        clean = not self._queue and not self._live
+        with self._cond:
+            clean = not self._queue and not self._live
         self.stop()
         return clean
 
@@ -1180,7 +1184,10 @@ class SlotScheduler:
         drift, non-finite logits, a ``serve_reload`` chaos fault —
         restores the old view references and the engine keeps serving
         version N."""
-        box = self._pending_swap
+        # snapshot the box under the cond: request_swap publishes it
+        # from the HTTP thread while the worker polls for it
+        with self._cond:
+            box = self._pending_swap
         if box is None:
             return
         e = self.engine
@@ -1337,7 +1344,14 @@ class SlotScheduler:
             sup_cm = contextlib.nullcontext()
         with sup_cm:
             while not self._stop.is_set():
-                if self._pending_swap is not None:
+                # one coherent snapshot of the cross-thread poll state
+                # per iteration (the HTTP thread publishes swaps and
+                # drains under the cond); _live/_free are worker-owned
+                with self._cond:
+                    swap_pending = self._pending_swap is not None
+                    draining = self._draining
+                    queue_empty = not self._queue
+                if swap_pending:
                     # admission pauses so _live can empty; queued +
                     # in-flight requests finish on the ADMITTED version
                     if not self._live:
@@ -1345,8 +1359,8 @@ class SlotScheduler:
                         continue
                 else:
                     self._admit()
-                if self._draining:
-                    if not self._live and not self._queue:
+                if draining:
+                    if not self._live and queue_empty:
                         self._drained.set()
                     elif monotonic() >= self._drain_deadline:
                         self._drain_expire()
