@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -15,7 +15,7 @@
 lint:
 	python -m trlx_tpu.analysis --budget 10
 
-check: lint kernels
+check: lint kernels defense
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| true
@@ -126,6 +126,30 @@ serve-mesh:
 router:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_router.py \
 		-q -m 'not slow'
+
+# defense-in-depth tier (docs "Fault tolerance", fleet containment):
+# the fast containment units — circuit-breaker state machine, retry
+# budget accounting + typed-503 exhaustion, hedge racing and its chaos
+# seam, response validation / failover over stub replicas, prober
+# debounce, and the checkpoint manifest (bit-flip / truncation / torn
+# meta detection, quarantine, run-dir fallback, component-scoped
+# verify). Stub-backed and CPU-cheap, so it gates `make check`; the
+# live-replica drills are the slow `make fleet-chaos` tier.
+defense:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_defense.py \
+		-q -m 'not slow'
+
+# fleet chaos harness: router + live replicas through the containment
+# drills end to end — replica killed mid-trace (zero lost requests,
+# failovers within the retry budget, oracle bit-parity), corrupt
+# checkpoint published mid-rollout (rollout aborts, fleet stays on the
+# old version, bad step quarantined), boot fallback past a corrupt
+# newest step, hedged requests against real engines, and a
+# corrupt-response backend contained by its breaker. Slow-marked (real
+# engine builds + warmups); opt-in via this target.
+fleet-chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_chaos.py \
+		-q -m slow
 
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \

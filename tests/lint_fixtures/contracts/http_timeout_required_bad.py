@@ -14,3 +14,15 @@ def connect(host):
 
 def connect_tls(host):
     return http.client.HTTPSConnection(host, 443)
+
+
+def hedge(url, results):
+    # the hedged-request path: the outbound call runs on a worker
+    # thread, but a missing timeout= still strands the waiter forever
+    import threading
+
+    def attempt():
+        with urllib.request.urlopen(url) as resp:
+            results.append(resp.read())
+
+    threading.Thread(target=attempt, daemon=True).start()

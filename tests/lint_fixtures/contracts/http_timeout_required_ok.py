@@ -17,3 +17,16 @@ def connect_tls(host):
     return http.client.HTTPSConnection(
         host, 443, timeout=PROBE_TIMEOUT_S
     )
+
+
+def hedge(url, results):
+    # hedged-request path: the worker's outbound call is timeout-bound
+    import threading
+
+    def attempt():
+        with urllib.request.urlopen(
+            url, timeout=PROBE_TIMEOUT_S
+        ) as resp:
+            results.append(resp.read())
+
+    threading.Thread(target=attempt, daemon=True).start()

@@ -410,6 +410,173 @@ def test_preemption_poll_interval_boundaries(monkeypatch):
     assert PreemptionGuard(poll_interval=0)._poll_interval == 1
 
 
+@pytest.fixture(scope="module")
+def pristine_checkpoint(tmp_path_factory):
+    """ONE real orbax-backed checkpoint of a tiny array tree, shared by
+    every integrity test below — each copies it (copytree is ~free; an
+    orbax save is seconds on 1 CPU) and corrupts the COPY. Returns
+    (path, components). tests/test_defense.py covers the same machinery
+    on hand-built dirs without orbax."""
+    from trlx_tpu.utils.checkpoint import save_components
+
+    components = {
+        "params": {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+                   "b": np.ones((8,), np.float32)},
+    }
+    directory = str(tmp_path_factory.mktemp("integrity") / "pristine")
+    save_components(components, directory)
+    return directory, components
+
+
+def _integrity_copy(pristine, destination):
+    import shutil
+
+    shutil.copytree(pristine[0], destination)
+    return destination
+
+
+def _template():
+    return {"params": {"w": np.zeros((8, 8), np.float32),
+                       "b": np.zeros((8,), np.float32)}}
+
+
+def _largest_file(directory):
+    """The biggest non-marker file under the checkpoint — the orbax
+    array data (meta.json is excluded: in a tiny checkpoint the
+    embedded manifest makes IT the largest file, and the torn-marker
+    path has its own test)."""
+    import os
+
+    best, size = None, -1
+    for root, _, files in os.walk(directory):
+        for fname in files:
+            if fname == "meta.json":
+                continue
+            path = os.path.join(root, fname)
+            if os.path.getsize(path) > size:
+                best, size = path, os.path.getsize(path)
+    return best
+
+
+def test_restore_detects_bitflipped_orbax_array_file(
+        tmp_path, pristine_checkpoint):
+    """A single flipped byte in the orbax-written array data must raise
+    the typed CheckpointCorrupt (and quarantine the dir) instead of
+    restoring wrong-but-finite weights silently."""
+    import os
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.utils.checkpoint import CheckpointCorrupt, restore_components
+
+    telemetry.start()
+    ck = _integrity_copy(pristine_checkpoint, str(tmp_path / "ck"))
+    target = _largest_file(ck)
+    with open(target, "r+b") as f:
+        f.seek(os.path.getsize(target) // 2)
+        byte = f.read(1)
+        f.seek(os.path.getsize(target) // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorrupt, match="hash mismatch"):
+        restore_components(_template(), ck)
+    assert not os.path.isdir(ck), "corrupt checkpoint must be quarantined"
+    assert telemetry.current().registry.counters[
+        "checkpoint/quarantined"] == 1.0
+
+
+def test_restore_detects_truncated_array_and_torn_meta(
+        tmp_path, pristine_checkpoint):
+    import os
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.utils.checkpoint import (
+        META_NAME,
+        CheckpointCorrupt,
+        restore_components,
+    )
+
+    telemetry.start()
+    ck = _integrity_copy(pristine_checkpoint, str(tmp_path / "ck"))
+    target = _largest_file(ck)
+    with open(target, "r+b") as f:
+        f.truncate(max(os.path.getsize(target) // 2, 1))
+    with pytest.raises(CheckpointCorrupt, match="truncated"):
+        restore_components(_template(), ck)
+
+    ck2 = _integrity_copy(pristine_checkpoint, str(tmp_path / "ck2"))
+    with open(os.path.join(ck2, META_NAME), "w") as f:
+        f.write('{"params": {"w"')  # torn mid-json.dump
+    with pytest.raises(CheckpointCorrupt, match="commit marker"):
+        restore_components(_template(), ck2)
+
+
+def test_run_dir_restore_falls_back_past_corrupt_step(
+        tmp_path, pristine_checkpoint):
+    """Auto-resume degrades to last-known-good: the newest step's bytes
+    are corrupt, so restore quarantines it and loads the previous
+    committed step instead of failing the run."""
+    import os
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.utils.checkpoint import (
+        find_latest_checkpoint,
+        restore_components,
+    )
+
+    telemetry.start()
+    run = str(tmp_path / "run")
+    os.makedirs(run)
+    good = pristine_checkpoint[1]
+    _integrity_copy(pristine_checkpoint, os.path.join(run, "step_1"))
+    _integrity_copy(pristine_checkpoint, os.path.join(run, "step_2"))
+    target = _largest_file(os.path.join(run, "step_2"))
+    with open(target, "r+b") as f:
+        f.seek(0)
+        byte = f.read(1)
+        f.seek(0)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    restored = restore_components(_template(), run)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(good["params"]["w"])
+    )
+    registry = telemetry.current().registry
+    assert registry.counters["checkpoint/quarantined"] == 1.0
+    assert registry.counters["checkpoint/verified"] >= 1.0
+    assert any(".corrupt-" in e for e in os.listdir(run)), (
+        "the corrupt step must survive as quarantined evidence"
+    )
+    latest = find_latest_checkpoint(run)
+    assert latest and latest.endswith("step_1")
+
+
+def test_premanifest_checkpoint_restores_with_verify_skipped(
+        tmp_path, pristine_checkpoint):
+    """Checkpoints written before the manifest existed restore as
+    before (backward compatibility) — counted, not rejected."""
+    import json
+    import os
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.utils.checkpoint import MANIFEST_KEY, META_NAME, restore_components
+
+    telemetry.start()
+    ck = _integrity_copy(pristine_checkpoint, str(tmp_path / "ck"))
+    saved = pristine_checkpoint[1]
+    meta_path = os.path.join(ck, META_NAME)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta.pop(MANIFEST_KEY)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    restored = restore_components(_template(), ck)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.asarray(saved["params"]["w"]),
+    )
+    assert telemetry.current().registry.counters[
+        "checkpoint/verify_skipped"] == 1.0
+
+
 def test_preemption_guard_restores_sig_dfl_for_c_handlers(monkeypatch):
     """When the previous SIGTERM handler was installed at the C level
     (getsignal() -> None), __exit__ restores SIG_DFL rather than leaving

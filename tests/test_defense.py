@@ -1,0 +1,585 @@
+"""Defense-in-depth units (docs "Fault tolerance", fleet containment):
+the circuit-breaker state machine, retry-budget token bucket, latency
+window, prober debounce, checkpoint manifest verification + quarantine
++ fallback, and router-level containment driven against scriptable stub
+backends (breaker opens/recovers, retry budget refuses the storm,
+hedged requests, response validation). The chaos drills here exercise
+the ``router_hedge`` and ``checkpoint_verify`` seams (KNOWN_SEAMS
+contract). Fast tier-1 — the live-replica acceptance drills live in
+tests/test_fleet_chaos.py (``make fleet-chaos``).
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trlx_tpu import telemetry
+from trlx_tpu.router import FleetRouter, RouterConfig
+from trlx_tpu.router.resilience import (
+    CircuitBreaker,
+    LatencyWindow,
+    RetryBudget,
+)
+from trlx_tpu.supervisor import chaos
+from trlx_tpu.utils.checkpoint import (
+    MANIFEST_KEY,
+    META_NAME,
+    CheckpointCorrupt,
+    _resolve_verified_dir,
+    build_manifest,
+    find_latest_checkpoint,
+    is_valid_checkpoint,
+    quarantine_checkpoint,
+    verify_checkpoint,
+    verify_or_quarantine,
+)
+
+# --------------------------------------------------------------------- #
+# resilience primitives: pure state machines, time passed by argument
+# --------------------------------------------------------------------- #
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(threshold=2, cooldown=1.0)
+    assert br.state == CircuitBreaker.CLOSED and br.allow(0.0)
+    # one failure: still closed (consecutive threshold is 2)
+    assert br.record_failure(0.0) is False
+    assert br.allow(0.1)
+    # a success resets the consecutive count
+    assert br.record_success() is False
+    assert br.record_failure(0.2) is False
+    # second CONSECUTIVE failure opens
+    assert br.record_failure(0.3) is True
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow(0.5), "open inside cooldown must refuse"
+    # cooldown elapsed: trial-eligible, but allow() is PURE — a
+    # candidate that loses the routing pick must not wedge half-open
+    assert br.allow(1.4)
+    assert br.state == CircuitBreaker.OPEN
+    # the actually-picked backend claims the trial slot
+    assert br.begin_trial(1.4) is True
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow(1.5), "one trial in flight: no second request"
+    assert br.begin_trial(1.5) is False
+    # trial failure re-opens immediately (one chance per cooldown)
+    assert br.record_failure(1.6) is True
+    assert br.state == CircuitBreaker.OPEN
+    # next trial succeeds and closes
+    assert br.begin_trial(2.7) is True
+    assert br.record_success() is True
+    assert br.state == CircuitBreaker.CLOSED and br.failures == 0
+
+
+def test_circuit_breaker_disabled_and_reset():
+    off = CircuitBreaker(threshold=0, cooldown=0.0)
+    for t in range(10):
+        off.record_failure(float(t))
+    assert off.state == CircuitBreaker.CLOSED and off.allow(99.0)
+
+    br = CircuitBreaker(threshold=1, cooldown=5.0)
+    br.record_failure(0.0)
+    assert br.state == CircuitBreaker.OPEN
+    br.reset()  # prober re-admission: restarted process, fresh history
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.failures == 0 and br.allow(0.0)
+
+
+def test_retry_budget_spend_refill_and_unlimited():
+    rb = RetryBudget(capacity=2.0, refill_per_s=1.0)
+    assert rb.try_spend(0.0) and rb.try_spend(0.0)
+    assert not rb.try_spend(0.0), "empty bucket must refuse"
+    # continuous refill: half a token at +0.5s is still not one
+    assert not rb.try_spend(0.5)
+    assert rb.try_spend(1.6), "refilled past one token"
+    assert rb.available(1.6) < 1.0
+    # refill clamps at capacity
+    assert rb.available(100.0) == pytest.approx(2.0)
+
+    unlimited = RetryBudget(capacity=0.0, refill_per_s=0.0)
+    assert all(unlimited.try_spend(0.0) for _ in range(100))
+    assert unlimited.available(0.0) == float("inf")
+
+
+def test_latency_window_p95_and_cold_floor():
+    win = LatencyWindow(size=16, min_samples=8)
+    for s in (0.1, 0.2, 0.3):
+        win.add(s)
+    assert win.p95() == 0.0, "cold window must defer to the floor"
+    for _ in range(20):
+        win.add(0.1)
+    win.add(9.0)
+    assert len(win) == 16  # ring: oldest samples overwritten
+    assert win.p95() == pytest.approx(9.0)
+
+
+# --------------------------------------------------------------------- #
+# prober debounce + breaker reset on re-admission (no sockets needed)
+# --------------------------------------------------------------------- #
+
+
+def test_probe_debounce_ejects_only_after_consecutive_failures():
+    telemetry.start()
+    registry = telemetry.current().registry
+    router = FleetRouter(RouterConfig(
+        backends=["127.0.0.1:1"], port=0, page_size=4,
+        probe_failures_threshold=2,
+    ))
+    (b,) = router.backends
+    b.admitted = True
+    b.ever_admitted = True
+    router._apply_probe(b, False, 0, {"probe_error": "timeout"})
+    assert b.admitted, "one failed sweep must not eject (debounced)"
+    assert registry.counters.get("router/ejections", 0.0) == 0.0
+    # a recovered sweep resets the consecutive count
+    router._apply_probe(b, True, 1, {"queue_depth": 0})
+    router._apply_probe(b, False, 0, {})
+    assert b.admitted and b.probe_failures == 1
+    router._apply_probe(b, False, 0, {})
+    assert not b.admitted, "second consecutive failure ejects"
+    assert registry.counters["router/ejections"] == 1.0
+    # re-admission resets the breaker: the replica restarted, its
+    # request-failure history died with the old process
+    b.breaker.record_failure(0.0)
+    b.breaker.record_failure(0.0)
+    b.breaker.record_failure(0.0)
+    assert b.breaker.state == CircuitBreaker.OPEN
+    router._apply_probe(b, True, 2, {"queue_depth": 0})
+    assert b.admitted
+    assert registry.counters["router/readmissions"] == 1.0
+    assert b.breaker.state == CircuitBreaker.CLOSED
+
+
+# --------------------------------------------------------------------- #
+# scriptable stub replicas: the router's containment against real HTTP
+# --------------------------------------------------------------------- #
+
+
+class _StubReplica:
+    """A /generate backend with a mutable failure mode: "ok", "e503",
+    "wrong_shape" (200 with a non-/generate JSON body), "garbage" (200
+    with bytes that are not JSON), "truncated" (Content-Length longer
+    than the body — a torn response), "slow" (sleeps ``delay`` then
+    answers ok)."""
+
+    def __init__(self, mode="ok", delay=0.0):
+        self.mode = mode
+        self.delay = delay
+        self.generate_calls = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A002
+                return
+
+            def _json(self, code, payload, pad=0):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body) + pad))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/readyz":
+                    self._json(200, {"ready": True, "model_version": 1})
+                elif self.path == "/debug/state":
+                    self._json(200, {"queue_depth": 0, "degraded": False})
+                else:
+                    self._json(404, {"error": "no route"})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                outer.generate_calls += 1
+                mode = outer.mode
+                if mode == "slow":
+                    time.sleep(outer.delay)
+                    mode = "ok"
+                if mode == "ok":
+                    self._json(200, {
+                        "tokens": list(req.get("tokens", [])) + [7],
+                        "model_version": 1,
+                        "trace": {"prefix_blocks_hit": 0},
+                    })
+                elif mode == "e503":
+                    self._json(503, {"error": "shedding"})
+                elif mode == "wrong_shape":
+                    self._json(200, {"result": "not a generate body"})
+                elif mode == "garbage":
+                    raw = b"\x00\xff this is not json"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                elif mode == "truncated":
+                    self._json(200, {"tokens": [1, 2, 3]}, pad=64)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _router_over(stubs, **overrides):
+    """A started router fronting the stubs, with a fresh telemetry
+    registry and the background prober effectively parked."""
+    telemetry.start()
+    cfg = dict(
+        backends=[f"127.0.0.1:{s.port}" for s in stubs], port=0,
+        page_size=64, probe_interval=30.0, probe_timeout=5.0,
+        request_timeout=10.0, failover_backoff=0.01,
+    )
+    cfg.update(overrides)
+    return FleetRouter(RouterConfig(**cfg)).start()
+
+
+@pytest.fixture
+def stub_pair():
+    stubs = [_StubReplica(), _StubReplica()]
+    yield stubs
+    for s in stubs:
+        s.stop()
+
+
+def test_breaker_opens_on_request_failures_then_half_open_recovers(
+    stub_pair,
+):
+    """The breaker-vs-prober separation: a replica 503ing its REQUESTS
+    while still answering /readyz is removed from placement by its
+    breaker (no membership churn), and a half-open trial after the
+    cooldown re-admits it once it answers cleanly."""
+    sick, healthy = stub_pair
+    sick.mode = "e503"
+    router = _router_over(
+        stub_pair, breaker_threshold=2, breaker_cooldown=0.3,
+        failover_retries=2,
+    )
+    registry = telemetry.current().registry
+    try:
+        body = {"tokens": [1, 2, 3], "max_new_tokens": 1}
+        # two requests: each prefers the 0-request sick replica, fails,
+        # and fails over — the second failure opens the breaker
+        for _ in range(2):
+            status, payload, _ = router.forward(dict(body))
+            assert status == 200, payload
+        assert registry.counters["router/breaker_opens"] == 1.0
+        (sick_b,) = [b for b in router.backends
+                     if b.url.endswith(f":{sick.port}")]
+        assert sick_b.breaker.state == CircuitBreaker.OPEN
+        assert sick_b.admitted, (
+            "the breaker must not touch prober membership"
+        )
+        # breaker-gated placement: traffic flows with ZERO failovers now
+        before = registry.counters["router/failovers"]
+        status, payload, _ = router.forward(dict(body))
+        assert status == 200
+        assert registry.counters["router/failovers"] == before
+        assert registry.gauges["router/breakers_open"] == 1.0
+        # replica recovers; after the cooldown one half-open trial goes
+        # through and closes the breaker
+        sick.mode = "ok"
+        time.sleep(0.35)
+        status, payload, _ = router.forward(dict(body))
+        assert status == 200
+        assert registry.counters["router/breaker_half_opens"] == 1.0
+        assert registry.counters["router/breaker_closes"] == 1.0
+        assert sick_b.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        router.stop()
+        telemetry.start()
+
+
+def test_retry_budget_exhausted_is_typed_503(stub_pair):
+    """Both replicas shedding + an empty bucket = the router refuses to
+    amplify: a typed 503 naming the budget, not an unbounded retry."""
+    for s in stub_pair:
+        s.mode = "e503"
+    router = _router_over(
+        stub_pair, breaker_threshold=0,  # keep replicas pickable
+        retry_budget=1.0, retry_budget_refill=0.0, failover_retries=5,
+    )
+    registry = telemetry.current().registry
+    try:
+        status, payload, _ = router.forward(
+            {"tokens": [1, 2], "max_new_tokens": 1}
+        )
+        assert status == 503
+        assert payload.get("retry_budget_exhausted") is True
+        assert "retry budget exhausted" in payload["error"]
+        assert registry.counters["router/retry_budget_spent"] == 1.0
+        assert registry.counters["router/retry_budget_exhausted"] == 1.0
+        assert registry.counters["router/failovers"] == 1.0, (
+            "exactly the one budgeted failover ran"
+        )
+        assert registry.gauges["router/retry_budget_tokens"] == 0.0
+    finally:
+        router.stop()
+        telemetry.start()
+
+
+def test_hedged_request_fires_and_first_response_wins():
+    """Tail-at-scale: the primary outliving the hedge delay gets a
+    backup on the other replica, and the fast response is the one the
+    client sees (router/hedge_wins)."""
+    slow = _StubReplica(mode="slow", delay=1.5)
+    fast = _StubReplica()
+    router = _router_over([slow, fast], hedge_after_s=0.1)
+    registry = telemetry.current().registry
+    try:
+        status, payload, _ = router.forward(
+            {"tokens": [1, 2, 3], "max_new_tokens": 1}
+        )
+        assert status == 200
+        assert payload["tokens"] == [1, 2, 3, 7]
+        assert registry.counters["router/hedges"] == 1.0
+        assert registry.counters["router/hedge_wins"] == 1.0
+        assert fast.generate_calls == 1, "the hedge landed on the fast replica"
+    finally:
+        router.stop()
+        for s in (slow, fast):
+            s.stop()
+        telemetry.start()
+
+
+def test_chaos_router_hedge_suppresses_but_request_completes():
+    """``router_hedge:exc`` at the hedge launch point: the backup is
+    suppressed (router/hedges_suppressed), the primary's response still
+    answers the client — a broken hedging path degrades to plain
+    forwarding, never to a lost request."""
+    slow = _StubReplica(mode="slow", delay=0.4)
+    fast = _StubReplica()
+    router = _router_over([slow, fast], hedge_after_s=0.1)
+    registry = telemetry.current().registry
+    chaos.configure("router_hedge:exc@1")
+    try:
+        status, payload, _ = router.forward(
+            {"tokens": [5, 6], "max_new_tokens": 1}
+        )
+        assert status == 200
+        assert payload["tokens"] == [5, 6, 7]
+        assert registry.counters["router/hedges_suppressed"] == 1.0
+        assert registry.counters["router/hedges"] == 0.0
+        assert fast.generate_calls == 0, "suppressed hedge never launched"
+    finally:
+        chaos.reset()
+        router.stop()
+        for s in (slow, fast):
+            s.stop()
+        telemetry.start()
+
+
+def test_malformed_200_body_fails_over_not_forwarded(stub_pair):
+    """A backend answering 200 with a non-/generate JSON body is a
+    request failure: router/response_invalid, a breaker strike, and a
+    failover — the garbage never reaches the client."""
+    bad, good = stub_pair
+    bad.mode = "wrong_shape"
+    router = _router_over(stub_pair, breaker_threshold=3)
+    registry = telemetry.current().registry
+    try:
+        status, payload, _ = router.forward(
+            {"tokens": [1, 2, 3], "max_new_tokens": 1}
+        )
+        assert status == 200
+        assert payload["tokens"] == [1, 2, 3, 7]
+        assert registry.counters["router/response_invalid"] == 1.0
+        assert registry.counters["router/failovers"] == 1.0
+        (bad_b,) = [b for b in router.backends
+                    if b.url.endswith(f":{bad.port}")]
+        assert bad_b.breaker.failures == 1
+    finally:
+        router.stop()
+        telemetry.start()
+
+
+def test_garbage_and_truncated_responses_fail_over(stub_pair):
+    """Non-JSON bytes and a torn body (Content-Length longer than what
+    arrived) both take the transport-failure path: retryable, breaker
+    strike, zero lost requests."""
+    bad, good = stub_pair
+    router = _router_over(stub_pair, breaker_threshold=0)
+    registry = telemetry.current().registry
+    try:
+        for mode in ("garbage", "truncated"):
+            bad.mode = mode
+            status, payload, _ = router.forward(
+                {"tokens": [9, 9, 9], "max_new_tokens": 1}
+            )
+            assert status == 200, (mode, payload)
+            assert payload["tokens"] == [9, 9, 9, 7]
+        assert registry.counters["router/failovers"] == 2.0
+        assert registry.counters["router/responses"] == 2.0
+    finally:
+        router.stop()
+        telemetry.start()
+
+
+# --------------------------------------------------------------------- #
+# checkpoint integrity: manifest build/verify, quarantine, fallback
+# (hand-built checkpoint dirs — the orbax-backed round trips live in
+# tests/test_checkpoint.py)
+# --------------------------------------------------------------------- #
+
+
+def _fake_checkpoint(directory, payload=b"weights-bytes", meta_extra=None):
+    """A committed checkpoint dir with a valid manifest, no orbax
+    needed: verify_checkpoint only sees files and meta.json."""
+    os.makedirs(os.path.join(directory, "params"), exist_ok=True)
+    with open(os.path.join(directory, "params", "data.bin"), "wb") as f:
+        f.write(payload)
+    meta = dict(meta_extra or {})
+    meta[MANIFEST_KEY] = {
+        "algo": "sha256", "files": build_manifest(directory),
+    }
+    with open(os.path.join(directory, META_NAME), "w") as f:
+        json.dump(meta, f)
+    return directory
+
+
+def test_manifest_verifies_clean_and_catches_bitflip(tmp_path):
+    telemetry.start()
+    registry = telemetry.current().registry
+    ck = _fake_checkpoint(str(tmp_path / "ck"))
+    assert verify_checkpoint(ck) is True
+    assert registry.counters["checkpoint/verified"] == 1.0
+    # flip one byte in the array file: same size, different content
+    path = os.path.join(ck, "params", "data.bin")
+    with open(path, "r+b") as f:
+        f.seek(3)
+        byte = f.read(1)
+        f.seek(3)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorrupt, match="hash mismatch"):
+        verify_checkpoint(ck)
+    assert registry.counters["checkpoint/verify_failures"] == 1.0
+
+
+def test_manifest_catches_truncation_and_missing_file(tmp_path):
+    telemetry.start()
+    ck = _fake_checkpoint(str(tmp_path / "ck"))
+    path = os.path.join(ck, "params", "data.bin")
+    with open(path, "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(CheckpointCorrupt, match="truncated"):
+        verify_checkpoint(ck)
+    os.remove(path)
+    with pytest.raises(CheckpointCorrupt, match="missing from disk"):
+        verify_checkpoint(ck)
+
+
+def test_torn_meta_json_is_checkpoint_corrupt(tmp_path):
+    telemetry.start()
+    ck = _fake_checkpoint(str(tmp_path / "ck"))
+    with open(os.path.join(ck, META_NAME), "w") as f:
+        f.write('{"__manifest__": {"algo": "sha2')  # torn mid-write
+    with pytest.raises(CheckpointCorrupt, match="commit marker"):
+        verify_checkpoint(ck)
+
+
+def test_premanifest_checkpoint_is_skipped_not_failed(tmp_path):
+    telemetry.start()
+    registry = telemetry.current().registry
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    with open(os.path.join(ck, META_NAME), "w") as f:
+        json.dump({"state": {"iter_count": 1}}, f)
+    assert verify_checkpoint(ck) is False
+    assert registry.counters["checkpoint/verify_skipped"] == 1.0
+
+
+def test_component_scoped_verify_ignores_other_components(tmp_path):
+    """The serve-side partial restore reads only params/ — damage to a
+    component it never loads must not block it."""
+    telemetry.start()
+    ck = _fake_checkpoint(str(tmp_path / "ck"))
+    os.makedirs(os.path.join(ck, "opt_state"))
+    with open(os.path.join(ck, "opt_state", "data.bin"), "wb") as f:
+        f.write(b"optimizer-bytes")
+    # rebuild the manifest to cover both components, then damage only
+    # opt_state
+    meta = {MANIFEST_KEY: {"algo": "sha256",
+                           "files": build_manifest(ck)}}
+    with open(os.path.join(ck, META_NAME), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(ck, "opt_state", "data.bin"), "wb") as f:
+        f.write(b"corrupted")
+    assert verify_checkpoint(ck, component="params") is True
+    with pytest.raises(CheckpointCorrupt):
+        verify_checkpoint(ck)
+
+
+def test_quarantine_renames_and_hides_from_resolution(tmp_path):
+    telemetry.start()
+    registry = telemetry.current().registry
+    run = tmp_path / "run"
+    ck = _fake_checkpoint(str(run / "step_2"))
+    _fake_checkpoint(str(run / "step_1"), payload=b"older-weights")
+    aside = quarantine_checkpoint(ck, reason="drill")
+    assert aside and ".corrupt-" in os.path.basename(aside)
+    assert os.path.isdir(aside) and not os.path.isdir(ck)
+    assert registry.counters["checkpoint/quarantined"] == 1.0
+    assert not is_valid_checkpoint(aside), (
+        "a quarantined dir must never resolve as a checkpoint"
+    )
+    latest = find_latest_checkpoint(str(run))
+    assert latest and latest.endswith("step_1")
+    # quarantining nothing (already gone) is a clean no-op
+    assert quarantine_checkpoint(ck) is None
+
+
+def test_run_dir_resolution_falls_back_past_corrupt_newest(tmp_path):
+    """The auto-resume degradation path: the newest step is corrupt, so
+    resolution quarantines it and lands on the previous good step; a
+    corrupt checkpoint pointed at DIRECTLY raises instead."""
+    telemetry.start()
+    registry = telemetry.current().registry
+    run = tmp_path / "run"
+    good = _fake_checkpoint(str(run / "step_1"), payload=b"known-good")
+    bad = _fake_checkpoint(str(run / "step_2"))
+    with open(os.path.join(bad, "params", "data.bin"), "ab") as f:
+        f.write(b"!!bit-rot!!")
+    resolved = _resolve_verified_dir(str(run), ["params"])
+    assert resolved == good
+    assert registry.counters["checkpoint/quarantined"] == 1.0
+    assert registry.counters["checkpoint/verify_failures"] == 1.0
+    # direct pointing: fail fast (nothing behind it to fall back to)
+    direct = _fake_checkpoint(str(tmp_path / "direct"))
+    with open(os.path.join(direct, "params", "data.bin"), "ab") as f:
+        f.write(b"!")
+    with pytest.raises(CheckpointCorrupt):
+        _resolve_verified_dir(direct, ["params"])
+    assert not os.path.isdir(direct), "direct corruption still quarantines"
+    # an empty run dir after quarantine is an actionable FileNotFoundError
+    lone = tmp_path / "lone"
+    ck = _fake_checkpoint(str(lone / "step_1"))
+    with open(os.path.join(ck, "params", "data.bin"), "ab") as f:
+        f.write(b"!")
+    with pytest.raises(FileNotFoundError, match="corrupt"):
+        _resolve_verified_dir(str(lone), ["params"])
+
+
+def test_chaos_checkpoint_verify_drives_quarantine(tmp_path):
+    """``checkpoint_verify:exc`` — the drill seam: an injected failure
+    IS a verification failure, driving the quarantine/fallback
+    machinery without hand-corrupting bytes."""
+    telemetry.start()
+    registry = telemetry.current().registry
+    ck = _fake_checkpoint(str(tmp_path / "ck"))
+    chaos.configure("checkpoint_verify:exc@1")
+    try:
+        with pytest.raises(CheckpointCorrupt, match="chaos-injected"):
+            verify_or_quarantine(ck)
+        assert registry.counters["checkpoint/quarantined"] == 1.0
+        assert not os.path.isdir(ck)
+    finally:
+        chaos.reset()

@@ -1,0 +1,310 @@
+"""Fleet chaos harness (``make fleet-chaos``, docs "Fault tolerance",
+fleet containment): a router + live replicas driven through the
+defense-in-depth drills end to end — a replica killed mid-trace with
+zero lost requests and failovers bounded by the retry budget, a corrupt
+checkpoint published mid-rollout aborting the upgrade with the fleet on
+its old version (and the corrupt step quarantined), engine boot falling
+back past a corrupt newest step, hedged requests against real engines,
+and a corrupt-response backend contained by its circuit breaker while
+the healthy replica keeps bit-identical parity with the direct
+single-engine oracle. Slow-marked: each scenario pays real engine
+builds/warmups; the fast containment units live in
+tests/test_defense.py (``make defense``).
+"""
+
+import os
+
+import pytest
+
+from trlx_tpu import telemetry
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.router.resilience import CircuitBreaker
+from trlx_tpu.serve import InferenceEngine, InferenceServer, ServeConfig
+from trlx_tpu.utils.loading import get_model
+from test_defense import _StubReplica
+from test_router import (
+    BUCKET,
+    MAX_NEW,
+    ROWS,
+    SERVE,
+    _burst,
+    _http,
+    _start_fleet,
+)
+from test_serve import tiny_config_dict
+from test_slots import direct_generate
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_pool_teardown():
+    """This module borrows test_router's warmed replica pool for the
+    checkpoint-less fleets; tear it down on module exit (the owning
+    module's autouse fixture does not apply here)."""
+    yield
+    import test_router
+
+    for s in test_router._POOL:
+        try:
+            s.stop()
+        except RuntimeError:
+            pass
+    test_router._POOL.clear()
+
+
+def _save_run_checkpoint(run, step, negate=False):
+    """A real trainer checkpoint under ``run/step_<step>`` (config
+    embedded, so engines boot from it with no extra YAML). ``negate``
+    flips every float weight: a DIFFERENT but finite version 2."""
+    import jax
+    import numpy as np
+
+    cfg = TRLConfig.from_dict(tiny_config_dict())
+    trainer = get_model(cfg.model.model_type)(cfg)
+    if negate:
+        trainer.params = jax.tree_util.tree_map(
+            lambda x: -x
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            trainer.params,
+        )
+    trainer.save(os.path.join(run, f"step_{step}"))
+    return os.path.join(run, f"step_{step}")
+
+
+def _corrupt_array_file(step_dir):
+    """Flip one byte in the largest non-marker file (the orbax array
+    data): same length, wrong bytes — exactly what crash-atomicity
+    alone cannot catch."""
+    best, size = None, -1
+    for root, _, files in os.walk(step_dir):
+        for fname in files:
+            if fname == "meta.json":
+                continue
+            path = os.path.join(root, fname)
+            if os.path.getsize(path) > size:
+                best, size = path, os.path.getsize(path)
+    with open(best, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return best
+
+
+def _oracle_rows(engine):
+    want = []
+    for at in range(0, len(ROWS), BUCKET[0]):
+        chunk = ROWS[at:at + BUCKET[0]]
+        oracle = direct_generate(engine, chunk, BUCKET, gen_size=MAX_NEW)
+        want.extend(engine.depad_row(oracle, j, MAX_NEW)
+                    for j in range(len(chunk)))
+    return want
+
+
+def test_fleet_chaos_acceptance_kill_and_corrupt_rollout(tmp_path):
+    """The acceptance drill, end to end: a checkpoint-backed fleet of 2
+    survives a replica killed mid-trace (zero lost requests, failovers
+    bounded by the retry budget, every surviving response bit-identical
+    to the direct single-engine oracle), then a corrupt step_2
+    published mid-rollout aborts the upgrade with the fleet still on
+    version 1 and the bad step quarantined — and the fleet keeps
+    serving with zero recompiles throughout."""
+    run = str(tmp_path / "run")
+    _save_run_checkpoint(run, step=1)
+    servers, router, close = _start_fleet(
+        n=2, checkpoint=os.path.join(run, "step_1"),
+        failover_retries=2, probe_interval=30.0, rollout_timeout=60.0,
+    )
+    registry = telemetry.current().registry
+    try:
+        want = _oracle_rows(servers[0].engine)
+
+        # --- drill 1: kill one replica mid-trace -------------------- #
+        # warm the affinity index so the kill lands on the replica the
+        # router actively prefers (worst case for failover)
+        for i in (0, 1):
+            status, _, body = _http(
+                router.port, "/generate", "POST",
+                {"tokens": ROWS[i], "max_new_tokens": MAX_NEW},
+            )
+            assert status == 200, body
+        owner_url = max(router.fleet_state()["backends"],
+                        key=lambda b: b["requests"])["url"]
+        victim = next(s for s in servers
+                      if owner_url.endswith(f":{s.port}"))
+        victim_port = victim.port
+        out, threads = _burst(router.port, ROWS)
+        victim.stop()  # mid-trace: some requests are in flight now
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads), "burst wedged"
+        for i, (status, _, body) in enumerate(out):
+            assert status == 200, f"request {i} lost in the kill: {body}"
+            assert body["tokens"] == want[i], (
+                f"request {i} diverged from the direct-engine oracle"
+            )
+        failovers = registry.counters["router/failovers"]
+        assert failovers <= router.config.retry_budget, (
+            "failovers must stay within the retry budget"
+        )
+        assert registry.counters["router/retry_budget_spent"] == failovers
+
+        # debounced ejection, then recovery on the same endpoint
+        router.probe_fleet()
+        router.probe_fleet()
+        assert router.admitting_count() == 1
+        revived = InferenceServer(
+            victim.engine, port=victim_port
+        ).start(warmup=True)
+        servers[servers.index(victim)] = revived
+        router.probe_fleet()
+        assert router.admitting_count() == 2
+        assert registry.counters["router/readmissions"] >= 1.0
+
+        # --- drill 2: corrupt checkpoint published mid-rollout ------ #
+        step2 = _save_run_checkpoint(run, step=2, negate=True)
+        _corrupt_array_file(step2)
+        status, _, body = _http(router.port, "/admin/rollout", "POST", {})
+        assert status == 409, body
+        assert body["ok"] is False
+        assert "corrupt" in str(body["steps"][0].get("reason", "")).lower()
+        assert registry.counters["router/rollout_aborts"] == 1.0
+        assert registry.counters["serve/reload_failures"] >= 1.0
+        assert registry.counters["checkpoint/quarantined"] >= 1.0
+        assert any(".corrupt-" in e for e in os.listdir(run)), (
+            "the corrupt step must be quarantined, not deleted"
+        )
+        assert router.admitting_count() == 2, (
+            "an aborted rollout must leave every replica admitted"
+        )
+        status, _, metrics = _http(router.port, "/metrics")
+        assert metrics["gauges"]["router/fleet_model_version"] == 1.0, (
+            "the fleet must still be on the OLD version after the abort"
+        )
+
+        # --- the fleet still serves, bit-identically, compiled ------ #
+        for i, row in enumerate(ROWS[:4]):
+            status, _, body = _http(
+                router.port, "/generate", "POST",
+                {"tokens": row, "max_new_tokens": MAX_NEW},
+            )
+            assert status == 200, body
+            assert body["tokens"] == want[i]
+            assert body["model_version"] == 1
+        status, _, metrics = _http(router.port, "/metrics")
+        assert metrics["counters"].get("compile/recompiles", 0.0) == 0.0
+
+        # --- drill 3: engine boot falls back past a corrupt newest -- #
+        step3 = _save_run_checkpoint(run, step=3, negate=True)
+        _corrupt_array_file(step3)
+        booted = InferenceEngine.from_checkpoint(
+            run, serve=ServeConfig(**SERVE)
+        )
+        assert booted.checkpoint_path.endswith("step_1"), (
+            "boot must degrade to the last-known-good step"
+        )
+    finally:
+        close()
+
+
+def test_hedged_requests_against_live_replicas():
+    """Hedging with real engines: an aggressive floor fires backups on
+    the sibling replica; every response — primary or hedge winner — is
+    bit-identical to the direct oracle, and losers never corrupt
+    placement (all subsequent responses stay correct)."""
+    servers, router, close = _start_fleet(
+        n=2, hedge_after_s=0.005, probe_interval=30.0,
+        failover_retries=2,
+    )
+    registry = telemetry.current().registry
+    try:
+        want = _oracle_rows(servers[0].engine)
+        for _ in range(2):  # second pass: hedges race warm caches too
+            for i, row in enumerate(ROWS):
+                status, _, body = _http(
+                    router.port, "/generate", "POST",
+                    {"tokens": row, "max_new_tokens": MAX_NEW},
+                )
+                assert status == 200, body
+                assert body["tokens"] == want[i], (
+                    f"request {i} diverged under hedging"
+                )
+        assert registry.counters["router/hedges"] >= 1.0, (
+            "a 5ms floor against CPU decode must fire at least one hedge"
+        )
+        assert registry.counters["router/responses"] == 2.0 * len(ROWS)
+        status, _, metrics = _http(router.port, "/metrics")
+        assert metrics["counters"].get("compile/recompiles", 0.0) == 0.0
+    finally:
+        close()
+
+
+def test_corrupt_response_backend_contained_by_breaker():
+    """A backend that answers /readyz but corrupts its /generate bodies
+    (the failure mode the prober CANNOT see) joins a real fleet: every
+    client response comes from the healthy replica bit-identically, the
+    breaker opens on the corrupt one and stops the failover churn, and
+    a prober ready-sweep must NOT reset that breaker."""
+    stub = _StubReplica(mode="wrong_shape")
+    servers, router, close = _start_fleet(
+        n=1, probe_interval=30.0, failover_retries=2,
+        breaker_threshold=2, breaker_cooldown=60.0,
+    )
+    registry = telemetry.current().registry
+    from trlx_tpu.router import Backend
+
+    with router._lock:
+        bad = Backend(f"127.0.0.1:{stub.port}",
+                      CircuitBreaker(2, 60.0))
+        bad.admitted = True
+        bad.ever_admitted = True
+        router.backends.append(bad)
+    try:
+        # DISTINCT prefixes: affinity never owns these, so placement is
+        # least-loaded with a requests tie-break — the corrupt stub
+        # (its requests count never grows: only winners are noted) is
+        # re-picked until its breaker opens. Shared-prefix rows would
+        # let the healthy replica's affinity ownership shield the stub
+        # after a single strike.
+        rows = [[1 + i, 2 + i, 3 + i, 5 + i, 8 + i, 13 + i]
+                for i in range(8)]
+        want = []
+        for at in range(0, len(rows), BUCKET[0]):
+            chunk = rows[at:at + BUCKET[0]]
+            engine = servers[0].engine
+            oracle = direct_generate(engine, chunk, BUCKET,
+                                     gen_size=MAX_NEW)
+            want.extend(engine.depad_row(oracle, j, MAX_NEW)
+                        for j in range(len(chunk)))
+        for i, row in enumerate(rows):
+            status, _, body = _http(
+                router.port, "/generate", "POST",
+                {"tokens": row, "max_new_tokens": MAX_NEW},
+            )
+            assert status == 200, body
+            assert body["tokens"] == want[i], (
+                "a corrupt backend's bytes must never reach the client"
+            )
+        assert registry.counters["router/response_invalid"] >= 2.0
+        assert registry.counters["router/breaker_opens"] == 1.0
+        assert bad.breaker.state == CircuitBreaker.OPEN
+        # the prober sees a READY corrupt replica; membership stays, the
+        # breaker must too (only re-admission after ejection resets it)
+        router.probe_fleet()
+        assert bad.admitted
+        assert bad.breaker.state == CircuitBreaker.OPEN, (
+            "a passing ready-sweep must not reset an open breaker"
+        )
+        # containment holds: more traffic, zero additional failovers
+        before = registry.counters["router/failovers"]
+        for row in ROWS[:4]:
+            status, _, body = _http(
+                router.port, "/generate", "POST",
+                {"tokens": row, "max_new_tokens": MAX_NEW},
+            )
+            assert status == 200, body
+        assert registry.counters["router/failovers"] == before
+    finally:
+        stub.stop()
+        close()

@@ -203,6 +203,10 @@ def test_prober_and_route_handlers_share_the_affinity_lock():
     router = FleetRouter(RouterConfig(
         backends=["127.0.0.1:1", "127.0.0.1:2"],
         port=0, page_size=2,
+        # the drill alternates ready/not-ready each sweep, so the
+        # default debounce (2 consecutive failures) would never eject
+        # and drop_backend would go unstressed
+        probe_failures_threshold=1,
     ))
     b1, b2 = router.backends
     for b in router.backends:
@@ -374,6 +378,11 @@ def test_failover_zero_loss_on_killed_backend():
         for i, (status, _, body) in enumerate(out):
             assert status == 200, f"request {i} lost in failover: {body}"
         router.probe_fleet()
+        assert router.admitting_count() == 2, (
+            "one failed sweep must not eject (debounced at "
+            "probe_failures_threshold=2)"
+        )
+        router.probe_fleet()  # second consecutive failure: now ejected
         assert router.admitting_count() == 1
         assert registry.counters["router/ejections"] >= 1.0
         status, _, body = _http(router.port, "/readyz")

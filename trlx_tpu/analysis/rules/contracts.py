@@ -20,7 +20,7 @@ _COUNTER_PREFIXES = ("serve/", "fault/", "checkpoint/", "chaos/",
                      "telemetry/", "compile/", "router/")
 
 #: namespaces the observability.rst catalog must cover
-_DOC_PREFIXES = ("serve/", "fault/", "router/")
+_DOC_PREFIXES = ("serve/", "fault/", "router/", "checkpoint/")
 
 _EMITTERS = ("inc", "set_gauge", "observe")
 

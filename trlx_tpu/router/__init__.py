@@ -26,16 +26,43 @@ operable as one unit. Four pieces:
   and the stale entries are decayed on the spot.
 - **Health-driven membership + failover.** A prober thread walks each
   backend's ``/readyz`` (admission) and ``/debug/state`` (queue depth,
-  degraded flag, model version) every ``probe_interval``; a non-ready or
-  unreachable replica is ejected from admission and re-admitted on
-  recovery. Idempotent-safe failures — connection errors, 429
-  (queue-full admission control), 503 (service-level shed) — retry on a
-  DIFFERENT replica through :func:`trlx_tpu.utils.faults.retry_call`,
-  honoring a server-provided ``Retry-After`` via its ``retry_after_s``
-  hint instead of pure jitter. Every hop stamps ``X-Request-Id`` through
-  unchanged (one trace id joins router and engine logs) and increments
-  ``X-Hop-Count`` (the engine rejects past ``MAX_HOPS`` with a typed
-  508, so a router misconfigured to point at itself cannot loop).
+  degraded flag, model version) every ``probe_interval``; a replica
+  non-ready or unreachable for ``probe_failures_threshold`` CONSECUTIVE
+  sweeps is ejected from admission (debounced: one transient probe
+  timeout no longer drops a healthy replica's affinity claims) and
+  re-admitted on the first recovered sweep. Idempotent-safe failures —
+  connection errors, truncated/malformed response bodies, 429
+  (queue-full admission control), 500/502 (replica-internal failure —
+  a scheduler dying mid-decode answers 500 before the socket drops),
+  503 (service-level shed) — retry on a
+  DIFFERENT replica, honoring a server-provided ``Retry-After``. Every
+  hop stamps ``X-Request-Id`` through unchanged (one trace id joins
+  router and engine logs) and increments ``X-Hop-Count`` (the engine
+  rejects past ``MAX_HOPS`` with a typed 508, so a router misconfigured
+  to point at itself cannot loop).
+- **Defense in depth against partial failure** (trlx_tpu.router
+  .resilience; docs "Fault tolerance", fleet containment). Failover
+  alone AMPLIFIES correlated overload — every 429/503 mints a new
+  request against a struggling sibling — so three structures bound it.
+  A per-backend **circuit breaker** (closed → open after
+  ``breaker_threshold`` consecutive request failures → half-open trial
+  after ``breaker_cooldown``) stops routing to a replica whose
+  REQUESTS fail even while its ``/readyz`` still answers — membership
+  (prober) and request health (breaker) are deliberately separate
+  signals, and a breaker-open replica keeps its affinity claims (its
+  cache is intact; its process is not restarted). A fleet-wide
+  token-bucket **retry budget** (``retry_budget`` capacity,
+  ``retry_budget_refill``/s) pays for every failover and every hedge;
+  an empty bucket refuses the retry with a typed 503
+  (``router/retry_budget_exhausted``) instead of joining a retry storm.
+  Optional **hedged requests** (``hedge_after_s`` > 0): when a primary
+  attempt outlives the rolling p95 of recent request latencies, one
+  backup fires on a different replica and the first response wins —
+  the loser is discarded WITHOUT touching affinity (only the winner's
+  placement is recorded). And **response validation**: a backend
+  answering 200 with a truncated or non-/generate-shaped JSON body is
+  a request failure that fails over, never garbage forwarded to the
+  client.
 - **Rolling checkpoint upgrades** (``POST /admin/rollout``). One replica
   at a time: fence it from routing (the engine's own ``/admin/drain`` is
   process-terminal by crash-only design, so the router drains at the
@@ -59,12 +86,15 @@ The router is host-side stdlib only — ``ThreadingHTTPServer`` in front,
 ``urllib.request`` toward the backends (every outbound call carries an
 explicit timeout; graftlint ``http-timeout-required`` enforces it), no
 JAX anywhere — and runs under the supervisor watchdog with its own
-chaos seams (``router_route`` / ``router_probe`` / ``router_rollout``,
-KNOWN_SEAMS). All timing is ``trlx_tpu.supervisor.monotonic``.
+chaos seams (``router_route`` / ``router_probe`` / ``router_rollout`` /
+``router_hedge``, KNOWN_SEAMS). All timing is
+``trlx_tpu.supervisor.monotonic``.
 """
 
 import contextlib
+import http.client
 import json
+import queue
 import threading
 import urllib.error
 import urllib.request
@@ -73,9 +103,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from trlx_tpu import supervisor, telemetry
+from trlx_tpu.router.resilience import (
+    CircuitBreaker,
+    LatencyWindow,
+    RetryBudget,
+)
 from trlx_tpu.serve.trace import new_trace_id
 from trlx_tpu.supervisor import RunSupervisor, chaos, monotonic
-from trlx_tpu.utils.faults import retry_call
 
 #: the router/* counter family, predeclared at start() so a scrape sees
 #: zeros, not gaps (graftlint metric-predeclared; docs "Observability")
@@ -92,6 +126,16 @@ _ROUTER_COUNTERS = (
     "router/rollouts",
     "router/rollout_steps",
     "router/rollout_aborts",
+    # defense-in-depth family (module docstring; docs "Fault tolerance")
+    "router/breaker_opens",
+    "router/breaker_half_opens",
+    "router/breaker_closes",
+    "router/retry_budget_spent",
+    "router/retry_budget_exhausted",
+    "router/hedges",
+    "router/hedge_wins",
+    "router/hedges_suppressed",
+    "router/response_invalid",
 )
 
 
@@ -102,8 +146,9 @@ class NoBackendAvailable(RuntimeError):
 
 class _UpstreamRetryable(RuntimeError):
     """A backend answered 429/503 (idempotent-safe service-level
-    failure) or was unreachable; carries the server-provided pacing so
-    retry_call's ``retry_after_s`` hint can honor it."""
+    failure), was unreachable, or returned a torn/malformed body;
+    carries the server-provided pacing so the failover loop can honor
+    its ``Retry-After`` instead of pure jitter."""
 
     def __init__(self, message: str, status: int = 0,
                  retry_after_s: Optional[float] = None,
@@ -149,6 +194,24 @@ class RouterConfig:
     slo_ttft_ms: float = 500.0
     #: watchdog budget for a prober sweep (0 = watchdog off)
     stall_timeout: float = 0.0
+    #: consecutive failed prober sweeps before a replica is ejected
+    #: (debounce: one transient probe timeout keeps its affinity claims)
+    probe_failures_threshold: int = 2
+    #: consecutive REQUEST failures that open a backend's circuit
+    #: breaker (0 disables breakers)
+    breaker_threshold: int = 3
+    #: seconds an open breaker waits before admitting one half-open
+    #: trial request
+    breaker_cooldown: float = 3.0
+    #: fleet-wide retry-budget token-bucket capacity: failovers AND
+    #: hedges each spend one token (0 = unlimited, PR-15 behavior)
+    retry_budget: float = 16.0
+    #: retry-budget sustained refill rate (tokens per second)
+    retry_budget_refill: float = 2.0
+    #: hedging floor in seconds: 0 disables hedging; > 0 fires a backup
+    #: request on a second replica after max(floor, rolling p95 of
+    #: recent request latencies) — first response wins
+    hedge_after_s: float = 0.0
 
     def __post_init__(self):
         if not self.backends:
@@ -162,6 +225,24 @@ class RouterConfig:
             raise ValueError("router.probe_interval must be > 0 seconds")
         if self.failover_retries < 0:
             raise ValueError("router.failover_retries must be >= 0")
+        if self.probe_failures_threshold < 1:
+            raise ValueError(
+                "router.probe_failures_threshold must be >= 1 sweep"
+            )
+        if self.breaker_threshold > 0 and self.breaker_cooldown <= 0:
+            raise ValueError(
+                "router.breaker_cooldown must be > 0 seconds when "
+                "breakers are enabled (breaker_threshold > 0)"
+            )
+        if self.retry_budget > 0 and self.retry_budget_refill < 0:
+            raise ValueError(
+                "router.retry_budget_refill must be >= 0 tokens/s"
+            )
+        if self.hedge_after_s < 0:
+            raise ValueError(
+                "router.hedge_after_s must be >= 0 seconds (0 disables "
+                "hedging)"
+            )
 
     @classmethod
     def from_dict(cls, config: Optional[dict]) -> "RouterConfig":
@@ -261,10 +342,11 @@ class AffinityIndex:
 
 
 class Backend:
-    """One engine replica as the router sees it. All fields are written
-    under the router's membership lock."""
+    """One engine replica as the router sees it. All fields — the
+    breaker's internal state included — are written under the router's
+    membership lock."""
 
-    def __init__(self, spec: str):
+    def __init__(self, spec: str, breaker: Optional[CircuitBreaker] = None):
         spec = spec.strip()
         if "//" not in spec:
             spec = "http://" + spec
@@ -277,6 +359,8 @@ class Backend:
         self.model_version = 0
         self.requests = 0         # requests routed here (lifetime)
         self.probe_failures = 0   # consecutive
+        #: request-level health, distinct from prober membership
+        self.breaker = breaker or CircuitBreaker(0, 0.0)
 
     def state(self) -> dict:
         return {
@@ -287,6 +371,7 @@ class Backend:
             "degraded": self.degraded,
             "model_version": self.model_version,
             "requests": self.requests,
+            "breaker": self.breaker.state,
         }
 
 
@@ -377,7 +462,12 @@ class FleetRouter:
 
     def __init__(self, config: RouterConfig):
         self.config = config
-        self.backends = [Backend(spec) for spec in config.backends]
+        self.backends = [
+            Backend(spec, CircuitBreaker(
+                config.breaker_threshold, config.breaker_cooldown
+            ))
+            for spec in config.backends
+        ]
         # prefix->backend placement state; the prober (drop_backend on
         # eviction), route handlers (match/insert/decay) and /fleet all
         # reach it, so every touch — reads included — goes through _lock
@@ -389,6 +479,12 @@ class FleetRouter:
         self._lock = threading.Lock()
         self._slo_good = 0    # guarded-by: _lock
         self._slo_total = 0   # guarded-by: _lock
+        #: fleet-wide failover/hedge token bucket (module docstring)
+        self._retry_budget = RetryBudget(  # guarded-by: _lock
+            config.retry_budget, config.retry_budget_refill
+        )
+        #: rolling request latencies; p95 sets the hedge delay
+        self._latency = LatencyWindow()  # guarded-by: _lock
         #: one rollout at a time; held for the whole walk
         self._rollout_lock = threading.Lock()
         self._stop = threading.Event()
@@ -482,6 +578,10 @@ class FleetRouter:
                 if not b.admitted and not b.rolling:
                     if b.ever_admitted:
                         telemetry.inc("router/readmissions")
+                        # a re-admitted replica is (usually) a restarted
+                        # process: its request-failure history died with
+                        # it, so the breaker starts closed
+                        b.breaker.reset()
                         print(f"[trlx_tpu.router] re-admitted {b.url} "
                               f"(model_version {b.model_version})",
                               flush=True)
@@ -489,13 +589,17 @@ class FleetRouter:
                     b.ever_admitted = True
             else:
                 b.probe_failures += 1
-                if b.admitted:
+                if b.admitted and b.probe_failures \
+                        >= self.config.probe_failures_threshold:
+                    # debounced: one transient probe timeout leaves the
+                    # replica admitted and its affinity claims intact
                     b.admitted = False
                     telemetry.inc("router/ejections")
                     # its radix cache is unreachable (or gone): stop
                     # predicting hits against it
                     self.affinity.drop_backend(b)
-                    print(f"[trlx_tpu.router] ejected {b.url} "
+                    print(f"[trlx_tpu.router] ejected {b.url} after "
+                          f"{b.probe_failures} failed sweeps "
                           f"({state.get('probe_error', 'not ready')})",
                           flush=True)
 
@@ -504,6 +608,16 @@ class FleetRouter:
             admitted = [b for b in self.backends if b.admitted]
             versions = [b.model_version for b in admitted if b.model_version]
             telemetry.set_gauge("router/admitting", float(len(admitted)))
+            telemetry.set_gauge(
+                "router/breakers_open",
+                float(sum(1 for b in self.backends
+                          if b.breaker.state != CircuitBreaker.CLOSED)),
+            )
+            if self._retry_budget.capacity > 0:
+                telemetry.set_gauge(
+                    "router/retry_budget_tokens",
+                    self._retry_budget.available(monotonic()),
+                )
             telemetry.set_gauge(
                 "router/degraded_backends",
                 float(sum(1 for b in admitted if b.degraded)),
@@ -553,26 +667,41 @@ class FleetRouter:
     def _pick(self, key, exclude) -> Tuple[Optional[Backend], int, str]:
         """(backend, predicted-depth, how) under the membership lock:
         longest affinity match first, else least-loaded with a degraded
-        replica's share halved (its effective queue depth doubled)."""
+        replica's share halved (its effective queue depth doubled).
+
+        Breaker-gated: an open breaker excludes its replica exactly
+        like ejection EXCEPT that affinity claims survive (the replica's
+        cache is intact — only its request path is sick); the caller
+        that wins the open→half-open transition carries the trial
+        request whose outcome closes or re-opens the breaker."""
         with self._lock:
-            admitted = [b for b in self.backends
-                        if b.admitted and b not in exclude]
+            now = monotonic()
+            admitted = [
+                b for b in self.backends
+                if b.admitted and b not in exclude and b.breaker.allow(now)
+            ]
             if not admitted:
                 return None, 0, ""
             allowed = set(admitted)
             backend, depth = self.affinity.match(
                 key, lambda b: b in allowed
             )
-            if backend is not None:
-                return backend, depth, "affinity"
-            backend = min(
-                admitted,
-                key=lambda b: (
-                    (b.queue_depth + 1) * (2 if b.degraded else 1),
-                    b.requests,
-                ),
-            )
-            return backend, 0, "least_loaded"
+            how = "affinity"
+            if backend is None:
+                backend = min(
+                    admitted,
+                    key=lambda b: (
+                        (b.queue_depth + 1) * (2 if b.degraded else 1),
+                        b.requests,
+                    ),
+                )
+                depth, how = 0, "least_loaded"
+            if backend.breaker.begin_trial(now):
+                telemetry.inc("router/breaker_half_opens")
+                print(f"[trlx_tpu.router] breaker half-open for "
+                      f"{backend.url}: admitting one trial request",
+                      flush=True)
+            return backend, depth, how
 
     def forward(self, body: dict, trace_id: Optional[str] = None,
                 hops: int = 0) -> Tuple[int, dict, dict]:
@@ -581,7 +710,14 @@ class FleetRouter:
         idempotent-safe errors onto a second replica honoring its
         ``Retry-After``. Returns (status, payload, response-headers) for
         the HTTP layer; also the direct entry point for in-process
-        callers (tests, bench)."""
+        callers (tests, bench).
+
+        Containment (module docstring): every failover spends a
+        retry-budget token — an empty bucket answers a typed 503
+        (``router/retry_budget_exhausted``) instead of multiplying
+        fleet load; each attempt is breaker-gated, hedged when
+        ``hedge_after_s`` > 0, and its response body validated before
+        it reaches the client."""
         telemetry.inc("router/requests")
         started = monotonic()
         try:
@@ -601,67 +737,57 @@ class FleetRouter:
         fwd_body = dict(body)
         fwd_body["trace"] = True
         tried: List[Backend] = []
-        picked: List[Tuple[Backend, int, str]] = []
-
-        def attempt():
-            backend, depth, how = self._pick(key, exclude=tried)
-            if backend is None:
-                raise NoBackendAvailable(
-                    f"no admitting replica (fleet of {len(self.backends)}; "
-                    f"{len(tried)} already tried this request)"
-                )
-            if tried:
-                telemetry.inc("router/failovers")
-            tried.append(backend)
-            picked.append((backend, depth, how))
+        failovers = 0
+        while True:
             try:
-                status, headers, payload = self._post_json(
-                    backend.url + "/generate", fwd_body,
-                    timeout=self.config.request_timeout,
-                    headers={
-                        "X-Request-Id": trace_id,
-                        "X-Hop-Count": str(hops + 1),
-                    },
+                status, payload, backend, depth, how = self._attempt_hedged(
+                    key, tried, fwd_body, trace_id, hops
                 )
-            except (OSError, ValueError) as e:
-                raise _UpstreamRetryable(
-                    f"{backend.url} unreachable "
-                    f"({type(e).__name__}: {e})"
-                ) from e
-            if status in (429, 503):
-                retry_after = headers.get("Retry-After")
-                raise _UpstreamRetryable(
-                    f"{backend.url} answered {status}: "
-                    f"{payload.get('error', '')}",
-                    status=status,
-                    retry_after_s=float(retry_after)
-                    if retry_after else None,
-                    payload=payload,
-                )
-            return status, headers, payload
+                break
+            except NoBackendAvailable as e:
+                telemetry.inc("router/request_errors")
+                return 503, {"error": str(e)}, {}
+            except _UpstreamRetryable as e:
+                failovers += 1
+                if failovers > self.config.failover_retries:
+                    # out of hops: surface the LAST upstream answer (429
+                    # keeps its pacing semantics; connection errors
+                    # become 503)
+                    telemetry.inc("router/request_errors")
+                    out_headers = {}
+                    if e.retry_after_s is not None:
+                        out_headers["Retry-After"] = str(
+                            int(e.retry_after_s)
+                        )
+                    return e.status or 503, e.payload, out_headers
+                if not self._spend_retry_token():
+                    # the structural bound on retry storms: refusing
+                    # beats amplifying, and the typed payload tells the
+                    # client this was the ROUTER's guardrail, not a
+                    # replica verdict
+                    telemetry.inc("router/retry_budget_exhausted")
+                    telemetry.inc("router/request_errors")
+                    return 503, {
+                        "error": (
+                            f"router retry budget exhausted "
+                            f"(capacity {self.config.retry_budget}, "
+                            f"refill {self.config.retry_budget_refill}"
+                            f"/s); last failure: {e}"
+                        ),
+                        "retry_budget_exhausted": True,
+                    }, {}
+                telemetry.inc("router/failovers")
+                delay = e.retry_after_s \
+                    if e.retry_after_s is not None \
+                    else self.config.failover_backoff
+                print(f"[trlx_tpu.router] failover "
+                      f"{failovers}/{self.config.failover_retries} in "
+                      f"{delay:.2g}s ({e})", flush=True)
+                if delay and delay > 0:
+                    self._stop.wait(delay)
 
-        try:
-            status, headers, payload = retry_call(
-                attempt,
-                retries=self.config.failover_retries,
-                backoff=self.config.failover_backoff,
-                label="router_forward",
-                retry_after_s=lambda e: getattr(e, "retry_after_s", None),
-            )
-        except NoBackendAvailable as e:
-            telemetry.inc("router/request_errors")
-            return 503, {"error": str(e)}, {}
-        except _UpstreamRetryable as e:
-            # budget exhausted: surface the LAST upstream answer (429
-            # keeps its pacing semantics; connection errors become 503)
-            telemetry.inc("router/request_errors")
-            out_headers = {}
-            if e.retry_after_s is not None:
-                out_headers["Retry-After"] = str(int(e.retry_after_s))
-            return e.status or 503, e.payload, out_headers
-
-        backend, depth, how = picked[-1]
-        self._note_routed(backend, key, depth, how, status, payload)
+        self._note_routed(backend, key, depth, how, status, payload,
+                          elapsed=monotonic() - started)
         telemetry.inc("router/responses")
         telemetry.observe("router/forward_time", monotonic() - started)
         out_headers = {"X-Request-Id": payload.get("trace_id", trace_id)}
@@ -669,13 +795,228 @@ class FleetRouter:
             payload.pop("trace", None)
         return status, payload, out_headers
 
+    def _attempt_backend(self, backend: Backend, fwd_body: dict,
+                         trace_id: str, hops: int) -> Tuple[int, dict]:
+        """One request against one replica, with the full failure
+        taxonomy applied: transport errors AND torn/malformed bodies
+        (json/http.client failures — truncated garbage must fail over,
+        never reach the client) are breaker strikes and retryable;
+        429 is retryable but NOT a strike (admission control from a
+        healthy replica); 500/502/503 are both — /generate is
+        idempotent, so a replica failing internally (a scheduler dying
+        mid-decode under a kill answers 500 before the socket goes)
+        must fail over, never surface. Success records a breaker
+        success. Returns (status, payload)."""
+        try:
+            status, headers, payload = self._post_json(
+                backend.url + "/generate", fwd_body,
+                timeout=self.config.request_timeout,
+                headers={
+                    "X-Request-Id": trace_id,
+                    "X-Hop-Count": str(hops + 1),
+                },
+            )
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            self._record_outcome(backend, ok=False)
+            raise _UpstreamRetryable(
+                f"{backend.url} unreachable or torn response "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        if status in (429, 500, 502, 503):
+            if status != 429:
+                self._record_outcome(backend, ok=False)
+            retry_after = headers.get("Retry-After")
+            raise _UpstreamRetryable(
+                f"{backend.url} answered {status}: "
+                f"{payload.get('error', '')}",
+                status=status,
+                retry_after_s=float(retry_after) if retry_after else None,
+                payload=payload,
+            )
+        if status == 200 and not (
+            isinstance(payload, dict) and isinstance(
+                payload.get("tokens"), list
+            )
+        ):
+            # parsed as JSON but is not a /generate response: the
+            # backend (or something between) corrupted the body —
+            # request failure, fail over, never forward garbage
+            self._record_outcome(backend, ok=False)
+            telemetry.inc("router/response_invalid")
+            shape = sorted(payload) if isinstance(payload, dict) \
+                else type(payload).__name__
+            raise _UpstreamRetryable(
+                f"{backend.url} answered 200 with a malformed /generate "
+                f"body (got {shape}, expected a JSON object with a "
+                f"'tokens' list)"
+            )
+        self._record_outcome(backend, ok=True)
+        return status, payload
+
+    def _attempt_hedged(self, key, tried: List[Backend], fwd_body: dict,
+                        trace_id: str, hops: int
+                        ) -> Tuple[int, dict, Backend, int, str]:
+        """One failover-loop iteration: pick a replica and attempt it,
+        optionally racing a hedged backup ("tail at scale"). With
+        hedging off this is a plain pick+attempt. With hedging on, a
+        primary that outlives max(hedge_after_s, rolling p95) gets one
+        backup on a different replica — budget-gated, chaos-seamed
+        (``router_hedge``) — and the FIRST response wins; the loser is
+        discarded without recording placement, so affinity only learns
+        the replica that actually answered."""
+        backend, depth, how = self._pick(key, exclude=tried)
+        if backend is None:
+            raise NoBackendAvailable(
+                f"no admitting replica (fleet of {len(self.backends)}; "
+                f"{len(tried)} already tried this request)"
+            )
+        tried.append(backend)
+        delay = self._hedge_delay()
+        if delay <= 0:
+            status, payload = self._attempt_backend(
+                backend, fwd_body, trace_id, hops
+            )
+            return status, payload, backend, depth, how
+
+        results: "queue.Queue" = queue.Queue()
+
+        def attempt_into(b: Backend, d: int, h: str) -> None:
+            try:
+                results.put(
+                    (None,) + self._attempt_backend(
+                        b, fwd_body, trace_id, hops
+                    ) + (b, d, h)
+                )
+            except Exception as e:  # delivered, not raised: the waiter
+                results.put((e, 0, None, b, d, h))  # must never strand
+
+        threading.Thread(
+            target=attempt_into, args=(backend, depth, how),
+            name="trlx-router-hedge", daemon=True,
+        ).start()
+        in_flight = 1
+        errors: List[Exception] = []
+        first = self._get_result(results, delay)
+        if first is not None:
+            in_flight -= 1
+            err, status, payload, b, d, h = first
+            if err is None:
+                return status, payload, b, d, h
+            errors.append(err)
+        if in_flight:
+            # primary outlived the tail cutoff: fire the backup
+            hedge_b, hedge_depth, _ = self._pick(key, exclude=tried)
+            if hedge_b is None or not self._spend_retry_token():
+                telemetry.inc("router/hedges_suppressed")
+            else:
+                try:
+                    chaos.maybe_inject("router_hedge")
+                    tried.append(hedge_b)
+                    telemetry.inc("router/hedges")
+                    threading.Thread(
+                        target=attempt_into,
+                        args=(hedge_b, hedge_depth, "hedge"),
+                        name="trlx-router-hedge", daemon=True,
+                    ).start()
+                    in_flight += 1
+                except chaos.ChaosError as e:
+                    telemetry.inc("router/hedges_suppressed")
+                    print(f"[trlx_tpu.router] hedge suppressed: {e}",
+                          flush=True)
+        deadline = monotonic() + self.config.request_timeout + 5.0
+        while in_flight > 0:
+            got = self._get_result(results, deadline - monotonic())
+            if got is None:
+                break  # both attempts outlived even request_timeout
+            in_flight -= 1
+            err, status, payload, b, d, h = got
+            if err is None:
+                if h == "hedge":
+                    telemetry.inc("router/hedge_wins")
+                return status, payload, b, d, h
+            errors.append(err)
+        for err in errors:
+            if isinstance(err, _UpstreamRetryable):
+                raise err
+        raise _UpstreamRetryable(
+            f"all hedged attempts against {[b.url for b in tried]} "
+            f"failed or timed out"
+            + (f": {errors[0]}" if errors else "")
+        )
+
+    @staticmethod
+    def _get_result(results: "queue.Queue", timeout: float):
+        """Bounded queue read (None on timeout) — the hedging race never
+        blocks unboundedly, graftlint's blocking-call tier included."""
+        if timeout <= 0:
+            return None
+        try:
+            return results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _hedge_delay(self) -> float:
+        """0 when hedging is off; else max(configured floor, rolling
+        p95) — the floor covers the cold window before enough latency
+        samples accumulate."""
+        floor = self.config.hedge_after_s
+        if floor <= 0:
+            return 0.0
+        with self._lock:
+            return max(self._latency.p95(), floor)
+
+    def _spend_retry_token(self) -> bool:
+        """Debit the fleet-wide retry budget for one failover or hedge;
+        False = bucket empty, the caller must not retry."""
+        with self._lock:
+            now = monotonic()
+            ok = self._retry_budget.try_spend(now)
+            if self._retry_budget.capacity > 0:
+                telemetry.set_gauge(
+                    "router/retry_budget_tokens",
+                    self._retry_budget.available(now),
+                )
+        if ok:
+            telemetry.inc("router/retry_budget_spent")
+        return ok
+
+    def _record_outcome(self, backend: Backend, ok: bool) -> None:
+        """Feed one request outcome to the backend's breaker (under the
+        membership lock) and mirror the open-breaker count gauge."""
+        with self._lock:
+            if ok:
+                if backend.breaker.record_success():
+                    telemetry.inc("router/breaker_closes")
+                    print(f"[trlx_tpu.router] breaker CLOSED for "
+                          f"{backend.url} (trial request succeeded)",
+                          flush=True)
+            else:
+                if backend.breaker.record_failure(monotonic()):
+                    telemetry.inc("router/breaker_opens")
+                    print(f"[trlx_tpu.router] breaker OPEN for "
+                          f"{backend.url} after "
+                          f"{backend.breaker.failures} consecutive "
+                          f"request failures (cooldown "
+                          f"{self.config.breaker_cooldown}s)", flush=True)
+            telemetry.set_gauge(
+                "router/breakers_open",
+                float(sum(1 for b in self.backends
+                          if b.breaker.state != CircuitBreaker.CLOSED)),
+            )
+
     def _note_routed(self, backend: Backend, key, depth: int, how: str,
-                     status: int, payload: dict) -> None:
+                     status: int, payload: dict,
+                     elapsed: Optional[float] = None) -> None:
         """Post-response bookkeeping: per-backend tallies, the affinity
-        insert + trace-feedback decay, hit rate, fleet goodput."""
+        insert + trace-feedback decay, hit rate, fleet goodput, and the
+        latency sample feeding the hedge-delay p95. Only the WINNING
+        attempt of a hedged race gets here — a discarded loser must not
+        claim affinity."""
         trace = payload.get("trace") if isinstance(payload, dict) else None
         with self._lock:
             backend.requests += 1
+            if elapsed is not None:
+                self._latency.add(elapsed)
             if how == "affinity":
                 telemetry.inc("router/affinity_hits")
             else:
@@ -846,6 +1187,11 @@ class FleetRouter:
         telemetry.set_gauge("router/affinity_hit_rate", 0.0)
         telemetry.set_gauge("router/fleet_goodput", 0.0)
         telemetry.set_gauge("router/rollout_in_progress", 0.0)
+        telemetry.set_gauge("router/breakers_open", 0.0)
+        if self.config.retry_budget > 0:
+            telemetry.set_gauge(
+                "router/retry_budget_tokens", self.config.retry_budget
+            )
         # one synchronous sweep so start() returns with membership known
         # (a request racing the first probe would 503 spuriously)
         self.probe_fleet()

@@ -49,6 +49,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = every completed request counts good)")
     p.add_argument("--stall-timeout", type=float, default=None,
                    help="watchdog budget per prober sweep (0 = off)")
+    p.add_argument("--probe-failures-threshold", type=int, default=None,
+                   help="consecutive failed prober sweeps before a "
+                        "replica is ejected (debounce)")
+    p.add_argument("--breaker-threshold", type=int, default=None,
+                   help="consecutive request failures that open a "
+                        "backend's circuit breaker (0 = breakers off)")
+    p.add_argument("--breaker-cooldown", type=float, default=None,
+                   help="seconds an open breaker waits before one "
+                        "half-open trial request")
+    p.add_argument("--retry-budget", type=float, default=None,
+                   help="fleet-wide failover/hedge token-bucket "
+                        "capacity (0 = unlimited)")
+    p.add_argument("--retry-budget-refill", type=float, default=None,
+                   help="retry-budget refill rate (tokens per second)")
+    p.add_argument("--hedge-after", type=float, default=None,
+                   dest="hedge_after_s",
+                   help="hedging floor in seconds: 0 disables; > 0 "
+                        "fires a backup request on a second replica "
+                        "after max(floor, rolling p95)")
     return p
 
 
@@ -66,7 +85,10 @@ def router_config_from_args(args) -> RouterConfig:
     cfg = RouterConfig.from_dict(section)
     for flag in ("host", "port", "page_size", "probe_interval",
                  "request_timeout", "failover_retries", "rollout_timeout",
-                 "slo_ttft_ms", "stall_timeout"):
+                 "slo_ttft_ms", "stall_timeout",
+                 "probe_failures_threshold", "breaker_threshold",
+                 "breaker_cooldown", "retry_budget",
+                 "retry_budget_refill", "hedge_after_s"):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, flag, value)

@@ -506,24 +506,45 @@ class InferenceEngine:
         meta.json, so ``python -m trlx_tpu.serve --checkpoint <dir>``
         needs nothing else. Only the ``params`` component is restored;
         opt_state/ref/value-head training baggage is stripped (module
-        docstring)."""
+        docstring).
+
+        Boot is integrity-gated: the candidate checkpoint's bytes are
+        verified against its manifest first, and when ``checkpoint`` is
+        a RUN dir a corrupt newest step is quarantined and boot falls
+        back to the previous good one (``CheckpointCorrupt`` only
+        surfaces when the caller pointed at a corrupt checkpoint
+        directly — there is nothing behind it to boot from)."""
         import json
         import os
 
         from trlx_tpu.utils.checkpoint import (
             META_NAME,
+            CheckpointCorrupt,
             find_latest_checkpoint,
             is_valid_checkpoint,
+            verify_or_quarantine,
         )
 
-        resolved = checkpoint if is_valid_checkpoint(checkpoint) \
-            else find_latest_checkpoint(checkpoint)
-        if resolved is None:
-            raise FileNotFoundError(
-                f"no committed checkpoint at '{checkpoint}' (expected a "
-                f"checkpoint dir with '{META_NAME}', or a run dir of "
-                f"'step_<N>' checkpoints)"
-            )
+        while True:
+            resolved = checkpoint if is_valid_checkpoint(checkpoint) \
+                else find_latest_checkpoint(checkpoint)
+            if resolved is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint at '{checkpoint}' (expected "
+                    f"a checkpoint dir with '{META_NAME}', or a run dir "
+                    f"of 'step_<N>' checkpoints)"
+                )
+            try:
+                verify_or_quarantine(resolved, component="params")
+                break
+            except CheckpointCorrupt:
+                if is_valid_checkpoint(checkpoint):
+                    raise  # pointed at the corrupt checkpoint itself
+                print(
+                    f"[trlx_tpu.serve] boot falling back past corrupt "
+                    f"checkpoint '{resolved}' under '{checkpoint}'",
+                    flush=True,
+                )
         if config is None:
             with open(os.path.join(resolved, META_NAME)) as f:
                 meta = json.load(f)
@@ -725,7 +746,15 @@ class InferenceEngine:
         staging during a reload is ~one leaf, not one model, and the
         training-only subtrees (reference branch, value head, opt state)
         never leave disk. The returned tree is exactly what
-        :meth:`strip_for_serve` / :meth:`_install_params` read."""
+        :meth:`strip_for_serve` / :meth:`_install_params` read.
+
+        The resolved checkpoint's ``params`` bytes are manifest-verified
+        before a single leaf lands on device; corruption quarantines the
+        step dir and raises ``CheckpointCorrupt`` — for the hot-swap
+        path that is deliberately FAIL-FAST (no silent fallback: the old
+        weights are still serving, and ``/admin/reload`` answering 409
+        is what makes a fleet rollout abort on the old version instead
+        of "succeeding" onto the step it already runs)."""
         from trlx_tpu.serve import layouts
         from trlx_tpu.utils.checkpoint import (
             find_latest_checkpoint,

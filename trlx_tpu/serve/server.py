@@ -84,6 +84,7 @@ from trlx_tpu.serve.batcher import (
     ReplayExhausted,
 )
 from trlx_tpu.serve.trace import SLO_COUNTERS, RequestTrace
+from trlx_tpu.utils.checkpoint import CheckpointCorrupt
 from trlx_tpu.supervisor import (
     RunSupervisor,
     SeamTimeout,
@@ -272,6 +273,18 @@ class _Handler(BaseHTTPRequestHandler):
                 result = srv.reload(body.get("checkpoint"))
             except (FileNotFoundError, ValueError) as e:
                 self._error(400, str(e))
+                return
+            except CheckpointCorrupt as e:
+                # integrity gate tripped BEFORE any leaf touched the
+                # device: the corrupt step is quarantined upstream and
+                # the old weights keep serving — a conflict (409), not a
+                # crash, and the typed reason is what makes a fleet
+                # rollout abort instead of retrying into the same bytes
+                telemetry.inc("serve/reload_failures")
+                self._json(409, {
+                    "reloaded": False,
+                    "reason": f"checkpoint corrupt: {e}",
+                })
                 return
             except Exception as e:
                 telemetry.inc("serve/reload_failures")

@@ -41,9 +41,16 @@ A schedule is a ``;``-separated list of rules::
   router's 500 error path without touching any backend), ``router_probe``
   (fired at the top of each health-prober sweep — an ``exc`` proves a
   failed sweep leaves fleet membership untouched rather than ejecting
-  everything), and ``router_rollout`` (fired at each per-replica rolling-
+  everything), ``router_rollout`` (fired at each per-replica rolling-
   upgrade step, before the replica is fenced — an ``exc`` aborts the
-  rollout with every replica re-admitted on its old version).
+  rollout with every replica re-admitted on its old version), and
+  ``router_hedge`` (fired just before a hedged backup request launches
+  — an ``exc`` suppresses ONLY the hedge, ``router/hedges_suppressed``;
+  the primary attempt still serves the request). Checkpointing adds
+  ``checkpoint_verify`` (fired at manifest-verification entry inside
+  ``trlx_tpu.utils.checkpoint.verify_checkpoint`` — an ``exc`` is
+  converted to ``CheckpointCorrupt`` and drives the quarantine/
+  fall-back-to-previous-step path exactly like real bit-rot).
 - ``action``: ``hang`` (block ``param`` seconds, default 3600 — a
   bounded seam times out, the watchdog sees everything else), ``exc``
   (raise :class:`ChaosError`), ``slow`` (sleep ``param`` seconds, default
@@ -107,6 +114,9 @@ KNOWN_SEAMS = (
     "router_route",
     "router_probe",
     "router_rollout",
+    "router_hedge",
+    # checkpoint-integrity seam (trlx_tpu.utils.checkpoint)
+    "checkpoint_verify",
 )
 
 _ACTIONS = ("hang", "exc", "slow", "sigterm")

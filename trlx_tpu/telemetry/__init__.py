@@ -70,6 +70,14 @@ _PREDECLARED_COUNTERS = (
     "fault/checkpoint_debris_cleared",
     "checkpoint/saves",
     "checkpoint/restores",
+    # end-to-end checkpoint byte integrity (utils.checkpoint manifest
+    # verification; docs "Fault tolerance", quarantine runbook):
+    # verified/skipped split restores by manifest coverage, failures
+    # and quarantines are the bit-rot alarm that must read 0
+    "checkpoint/verified",
+    "checkpoint/verify_skipped",
+    "checkpoint/verify_failures",
+    "checkpoint/quarantined",
     # steady-state executable-cache misses after warmup
     # (trlx_tpu.utils.aotjit): a sharding/layout drift that recompiles
     # every step shows up as a counter climbing with iter, not silence

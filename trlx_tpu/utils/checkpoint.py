@@ -24,6 +24,27 @@ preemption — the save itself survives being preempted:
   (falling back to the newest valid step inside), and raises ONE
   actionable error — expected components vs. what is actually on disk —
   instead of a bare per-component FileNotFoundError.
+- **End-to-end byte integrity** (docs "Fault tolerance", fleet
+  containment). Crash-atomicity protects against TORN writes; it says
+  nothing about bit-rot, a truncated object-store download, or a torn
+  meta.json forged by a buggy tool — all of which previously restored
+  garbage weights silently into the trainer, the serve hot-swap, and a
+  fleet-wide rollout (the reload smoke probe only catches non-finite
+  logits, not wrong-but-finite ones). ``save_components`` now embeds a
+  per-file SHA-256 manifest in meta.json (still the last-written commit
+  marker, so the manifest commits atomically with the checkpoint);
+  every restore path calls :func:`verify_checkpoint` first and raises
+  the typed :class:`CheckpointCorrupt` on any mismatch. A corrupt step
+  directory is **quarantined** — renamed ``step_<N>.corrupt-<suffix>``
+  (``checkpoint/quarantined``), which makes it invisible to
+  ``find_latest_checkpoint`` — so trainer auto-resume, engine boot, and
+  ``/admin/reload`` all degrade to the previous good step instead of
+  installing garbage. Pre-manifest checkpoints restore as before
+  (``checkpoint/verify_skipped``).
+- The commit renames themselves are durable: after every
+  ``os.replace`` the parent directory is fsynced — without it a power
+  loss can forget the rename even though the file contents were synced
+  (the renamed entry lives in the DIRECTORY's blocks).
 
 Only JAX process 0 writes (single-writer; params are replicated or
 re-shardable on restore) — gated HERE, not at call sites, so every save
@@ -31,6 +52,8 @@ path inherits it. Components are a flat dict {name: pytree | scalar-dict};
 arrays go through Orbax, plain-python metadata through JSON.
 """
 
+import hashlib
+import itertools
 import json
 import os
 import re
@@ -45,6 +68,18 @@ import numpy as np
 META_NAME = "meta.json"
 LATEST_NAME = "LATEST"
 _STEP_RE = re.compile(r"^step_(\d+)$")
+#: reserved meta.json key carrying the per-file integrity manifest —
+#: never a component name (double underscores keep it out of any
+#: trainer's get_components() namespace)
+MANIFEST_KEY = "__manifest__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """Checkpoint bytes failed end-to-end verification against the
+    manifest in its commit marker (bit-rot, truncation, a torn
+    meta.json). The directory has been quarantined when possible; run
+    dirs fall back to the previous good step, explicit checkpoint paths
+    surface this error."""
 
 
 def _is_array_tree(obj: Any) -> bool:
@@ -72,27 +107,195 @@ def _main_process() -> bool:
     return is_main_process()
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-committed ``os.replace`` rename
+    survives power loss — fsyncing the file pins its contents, but the
+    rename lives in the parent directory's blocks. Best-effort on
+    filesystems/platforms that refuse O_RDONLY directory handles (the
+    rename is still crash-atomic there, just not power-loss-durable)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. Windows: directories are not openable for fsync
+    try:
+        os.fsync(fd)
+    except OSError:
+        return  # e.g. fsync unsupported on this mount; stay best-effort
+    finally:
+        os.close(fd)
+
+
 def _atomic_write_text(text: str, path: str) -> None:
     """write-temp-then-rename: readers see the old content or the new,
     never a torn write (a preemption mid-``json.dump`` previously left a
-    truncated meta.json under the final name)."""
+    truncated meta.json under the final name). The parent directory is
+    fsynced after the rename so the COMMIT survives power loss too."""
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(text)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 def is_valid_checkpoint(directory: str) -> bool:
-    """Committed checkpoint dir: exists, is not a staging/aside leftover,
-    and carries the commit marker (meta.json, written last)."""
+    """Committed checkpoint dir: exists, is not a staging/aside/
+    quarantine leftover, and carries the commit marker (meta.json,
+    written last)."""
     base = os.path.basename(os.path.normpath(directory))
-    if ".tmp-" in base or ".old-" in base:
+    if ".tmp-" in base or ".old-" in base or ".corrupt-" in base:
         return False
     return os.path.isdir(directory) and os.path.exists(
         os.path.join(directory, META_NAME)
     )
+
+
+def _file_sha256(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def build_manifest(directory: str) -> Dict[str, Dict[str, Any]]:
+    """Per-file integrity manifest of everything under ``directory``:
+    ``{relpath: {"sha256": hex, "bytes": size}}``, excluding meta.json
+    itself (it CARRIES the manifest). Paths use '/' separators so a
+    checkpoint verifies across platforms."""
+    directory = os.path.abspath(directory)
+    manifest: Dict[str, Dict[str, Any]] = {}
+    for root, _, files in os.walk(directory):
+        for fname in sorted(files):
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, directory).replace(os.sep, "/")
+            if rel == META_NAME:
+                continue
+            manifest[rel] = {
+                "sha256": _file_sha256(path),
+                "bytes": os.path.getsize(path),
+            }
+    return manifest
+
+
+def verify_checkpoint(directory: str, component: Optional[str] = None) -> bool:
+    """Verify ``directory``'s bytes against the manifest in its commit
+    marker. Returns True when verified, False when the checkpoint
+    predates manifests (nothing to verify against —
+    ``checkpoint/verify_skipped``). Raises :class:`CheckpointCorrupt`
+    naming the first damaged file on any mismatch, and for a torn or
+    unreadable meta.json (the marker itself is damage). ``component``
+    limits verification to one component's files (the serve-side
+    partial restore reads only ``params/``)."""
+    from trlx_tpu import telemetry
+    from trlx_tpu.supervisor import chaos
+
+    directory = os.path.abspath(directory)
+
+    def corrupt(detail: str) -> CheckpointCorrupt:
+        telemetry.inc("checkpoint/verify_failures")
+        return CheckpointCorrupt(
+            f"checkpoint '{directory}' failed integrity verification: "
+            f"{detail}. The bytes on disk are not the bytes that were "
+            f"saved — do not install them; quarantine and fall back to "
+            f"the previous step (docs 'Fault tolerance', quarantine "
+            f"runbook)."
+        )
+
+    try:
+        # the drill seam: an injected exc IS a verification failure,
+        # driving quarantine/fallback exactly like real bit-rot
+        chaos.maybe_inject("checkpoint_verify")
+    except chaos.ChaosError as e:
+        raise corrupt(f"chaos-injected ({e})") from e
+    meta_path = os.path.join(directory, META_NAME)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise corrupt(
+            f"torn/unreadable '{META_NAME}' ({type(e).__name__}: {e}) — "
+            f"the commit marker itself is damaged"
+        ) from e
+    manifest = meta.get(MANIFEST_KEY) if isinstance(meta, dict) else None
+    if manifest is None:
+        telemetry.inc("checkpoint/verify_skipped")
+        return False
+    files = dict(manifest.get("files") or {})
+    if component is not None:
+        prefix = component.rstrip("/") + "/"
+        files = {rel: e for rel, e in files.items() if rel.startswith(prefix)}
+    for rel in sorted(files):
+        entry = files[rel]
+        path = os.path.join(directory, *rel.split("/"))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            raise corrupt(f"'{rel}' is missing from disk") from None
+        if int(entry.get("bytes", size)) != size:
+            raise corrupt(
+                f"'{rel}' is truncated: manifest says "
+                f"{entry['bytes']} bytes, disk has {size}"
+            )
+        digest = _file_sha256(path)
+        if digest != entry.get("sha256"):
+            raise corrupt(
+                f"'{rel}' content hash mismatch (sha256 {digest} != "
+                f"manifest {entry.get('sha256')}) — bit-rot or an "
+                f"out-of-band overwrite"
+            )
+    telemetry.inc("checkpoint/verified")
+    return True
+
+
+#: collision counter for quarantine renames within one process — paired
+#: with the pid (not wall time: library timing goes through the
+#: supervisor clock, and a quarantine name only needs uniqueness)
+_quarantine_seq = itertools.count(1)
+
+
+def quarantine_checkpoint(directory: str, reason: str = "") -> Optional[str]:
+    """Rename a corrupt checkpoint aside as ``<dir>.corrupt-<suffix>``
+    so ``find_latest_checkpoint`` stops resolving it and the evidence
+    survives for the operator (quarantined dirs are never GC'd).
+    Returns the quarantine path, or None when the rename was impossible
+    (already gone, or a sibling process won the race)."""
+    from trlx_tpu import telemetry
+
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    aside = f"{directory}.corrupt-{os.getpid()}"
+    while os.path.exists(aside):
+        aside = f"{directory}.corrupt-{os.getpid()}-{next(_quarantine_seq)}"
+    try:
+        os.replace(directory, aside)
+    except OSError:
+        return None  # concurrent quarantine/GC won; nothing left to move
+    _fsync_dir(os.path.dirname(aside) or ".")
+    telemetry.inc("checkpoint/quarantined")
+    print(
+        f"[trlx_tpu] QUARANTINED corrupt checkpoint '{directory}' -> "
+        f"'{aside}'" + (f" ({reason})" if reason else ""),
+        flush=True,
+    )
+    return aside
+
+
+def verify_or_quarantine(directory: str,
+                         component: Optional[str] = None) -> bool:
+    """:func:`verify_checkpoint`, quarantining the directory on failure
+    before re-raising — the restore paths' one-call integrity gate."""
+    try:
+        return verify_checkpoint(directory, component=component)
+    except CheckpointCorrupt as e:
+        aside = quarantine_checkpoint(directory, reason=str(e))
+        if aside is not None:
+            raise CheckpointCorrupt(
+                f"{e} [quarantined to '{aside}']"
+            ) from e
+        raise
 
 
 def save_components(components: Dict[str, Any], directory: str) -> None:
@@ -129,7 +332,13 @@ def save_components(components: Dict[str, Any], directory: str) -> None:
                     writer.save(os.path.join(staging, name), obj, force=True)
                 else:
                     meta[name] = obj
-        # the commit marker: written last, atomically, inside staging
+        # integrity manifest over everything the writers produced (built
+        # AFTER the checkpointers close, so async flushes are on disk),
+        # then the commit marker: written last, atomically, inside
+        # staging — manifest and checkpoint commit as one unit
+        meta[MANIFEST_KEY] = {
+            "algo": "sha256", "files": build_manifest(staging),
+        }
         _atomic_write_text(json.dumps(meta), os.path.join(staging, META_NAME))
 
         if os.path.isdir(directory):
@@ -145,6 +354,9 @@ def save_components(components: Dict[str, Any], directory: str) -> None:
             shutil.rmtree(aside)
         else:
             os.replace(staging, directory)
+        # the promote rename lives in the parent directory's blocks;
+        # without this fsync a power loss can undo the commit
+        _fsync_dir(parent or ".")
         telemetry.inc("checkpoint/saves")
 
 
@@ -236,34 +448,72 @@ def _resolve_restore_dir(directory: str) -> Optional[str]:
     return find_latest_checkpoint(directory)
 
 
+def _resolve_verified_dir(directory: str, expected,
+                          component: Optional[str] = None) -> str:
+    """Resolve-and-verify loop shared by the restore paths: resolve
+    ``directory`` (checkpoint or run dir), byte-verify the candidate,
+    and on corruption quarantine it and — when ``directory`` is a run
+    dir — resolve again, walking back to the previous good step. A
+    corrupt checkpoint pointed at DIRECTLY re-raises: there is nothing
+    behind it to fall back to."""
+    previous = None
+    while True:
+        pointed_directly = is_valid_checkpoint(directory)
+        resolved = directory if pointed_directly \
+            else find_latest_checkpoint(directory)
+        if resolved is None:
+            if os.path.isdir(directory):
+                contents = sorted(os.listdir(directory)) or ["<empty>"]
+                detail = (
+                    f"exists but holds no committed checkpoint: {contents}"
+                )
+            else:
+                detail = "does not exist"
+            raise FileNotFoundError(
+                f"no checkpoint at '{directory}' ({detail}). Expected "
+                f"either a checkpoint directory with components "
+                f"{expected} + '{META_NAME}', or a run directory "
+                f"containing committed 'step_<N>' checkpoints. A save "
+                f"killed mid-write leaves only a '*.tmp-*' staging dir "
+                f"and a corrupt one is quarantined as '*.corrupt-*' — "
+                f"neither is restorable; point resume_from at the run "
+                f"directory (or 'auto') to fall back to the newest "
+                f"committed step."
+            )
+        try:
+            verify_or_quarantine(resolved, component=component)
+            return resolved
+        except CheckpointCorrupt:
+            if pointed_directly or resolved == previous:
+                # nothing behind it to fall back to — or the quarantine
+                # rename failed and resolution is stuck on the same dir
+                raise
+            previous = resolved
+            print(
+                f"[trlx_tpu] falling back past corrupt checkpoint "
+                f"'{resolved}' to the previous good step under "
+                f"'{directory}'",
+                flush=True,
+            )
+
+
 def restore_components(template: Dict[str, Any], directory: str) -> Dict[str, Any]:
     """Restore into the structure of `template` (same component names/shapes).
 
     `directory` may be a single checkpoint or a run dir of ``step_<N>``
     checkpoints (the newest valid one is used — half-written ones are
-    skipped). Missing paths/components raise ONE error naming what was
-    expected and what is actually on disk, instead of a bare
-    per-component FileNotFoundError."""
+    skipped). Every candidate is byte-verified against its manifest
+    first: a corrupt step is quarantined and, when ``directory`` is a
+    run dir, the previous good step is tried instead (auto-resume
+    degrades to last-known-good); pointing at a corrupt checkpoint
+    DIRECTLY raises :class:`CheckpointCorrupt`. Missing
+    paths/components raise ONE error naming what was expected and what
+    is actually on disk, instead of a bare per-component
+    FileNotFoundError."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    resolved = _resolve_restore_dir(directory)
-    if resolved is None:
-        if os.path.isdir(directory):
-            contents = sorted(os.listdir(directory)) or ["<empty>"]
-            detail = f"exists but holds no committed checkpoint: {contents}"
-        else:
-            detail = "does not exist"
-        raise FileNotFoundError(
-            f"no checkpoint at '{directory}' ({detail}). Expected either a "
-            f"checkpoint directory with components "
-            f"{sorted(template)} + '{META_NAME}', or a run directory "
-            f"containing committed 'step_<N>' checkpoints. A save killed "
-            f"mid-write leaves only a '*.tmp-*' staging dir — that is not "
-            f"restorable; point resume_from at the run directory (or "
-            f"'auto') to fall back to the newest committed step."
-        )
-    directory = resolved
+    directory = _resolve_verified_dir(directory, sorted(template))
     out = {}
     meta_path = os.path.join(directory, META_NAME)
     meta = {}
@@ -318,17 +568,13 @@ def restore_component_sharded(
     staging is Orbax's per-leaf pipeline — peak ~one leaf, never the
     whole tree — and a tp/fsdp-sharded engine reads only its shards of
     each leaf. ``directory`` resolves like :func:`restore_components`
-    (checkpoint dir or run dir)."""
+    (checkpoint dir or run dir), byte-verifying ONLY this component's
+    manifest entries — a corrupt step is quarantined and a run dir
+    falls back to the previous good one."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    resolved = _resolve_restore_dir(directory)
-    if resolved is None:
-        raise FileNotFoundError(
-            f"no committed checkpoint at '{directory}' to restore "
-            f"'{name}' from (expected a checkpoint dir with "
-            f"'{META_NAME}', or a run dir of 'step_<N>' checkpoints)"
-        )
+    resolved = _resolve_verified_dir(directory, [name], component=name)
     path = os.path.join(resolved, name)
     if not os.path.isdir(path):
         raise FileNotFoundError(
