@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos obs
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -15,7 +15,7 @@
 lint:
 	python -m trlx_tpu.analysis --budget 10
 
-check: lint kernels defense
+check: lint kernels defense obs
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| true
@@ -137,6 +137,18 @@ router:
 # live-replica drills are the slow `make fleet-chaos` tier.
 defense:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_defense.py \
+		-q -m 'not slow'
+
+# fleet observability tier (docs "Observability"): labeled-metric
+# storage + Prometheus exposition (label sets, cumulative _bucket
+# histogram family, sanitize-collision disambiguation), the SLO
+# window/burn-rate engine, stitched fleet traces (FleetTrace ring,
+# sampled access log with tail capture + rotation), and the
+# `python -m trlx_tpu.obs` CLI — including a subprocess smoke run of
+# summarize/trace/tail against the fixture access.jsonl. Stub-backed
+# and CPU-cheap, so it gates `make check`.
+obs:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 		-q -m 'not slow'
 
 # fleet chaos harness: router + live replicas through the containment

@@ -1,0 +1,7 @@
+from trlx_tpu import telemetry
+
+
+def record(kind, value):
+    telemetry.observe(f"serve/latency_{kind}", value)
+    telemetry.inc("router/picked_" + kind)
+    telemetry.set_gauge("slo/goodput_{}".format(kind), value)

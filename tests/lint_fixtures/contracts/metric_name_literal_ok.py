@@ -1,0 +1,10 @@
+from trlx_tpu import telemetry
+
+GOODPUT_GAUGE = "slo/goodput_5m"
+
+
+def record(kind, value):
+    telemetry.observe("serve/request_latency", value,
+                      labels={"path": kind})
+    telemetry.inc("router/picked", labels={"how": kind})
+    telemetry.set_gauge(GOODPUT_GAUGE, value)

@@ -12,10 +12,15 @@ builds/warmups; the fast containment units live in
 tests/test_defense.py (``make defense``).
 """
 
+import json
 import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+from trlx_tpu import obs as obslib
 from trlx_tpu import telemetry
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.router.resilience import CircuitBreaker
@@ -307,4 +312,170 @@ def test_corrupt_response_backend_contained_by_breaker():
         assert registry.counters["router/failovers"] == before
     finally:
         stub.stop()
+        close()
+
+
+class _KillableReplica:
+    """A /generate backend whose in-flight request can be KILLED:
+    ``do_POST`` parks on the ``die`` event and, once it fires, returns
+    without writing a response — the connection drops mid-request,
+    which is exactly the socket-level signature of a replica process
+    dying mid-decode. ``in_flight`` fires when a /generate request has
+    actually reached the handler, so the test can sequence the kill."""
+
+    def __init__(self):
+        self.in_flight = threading.Event()
+        self.die = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A002
+                return
+
+            def do_GET(self):  # noqa: N802
+                payload = {"ready": True, "model_version": 1} \
+                    if self.path == "/readyz" \
+                    else {"queue_depth": 0, "degraded": False}
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                outer.in_flight.set()
+                outer.die.wait(timeout=20.0)
+                # no response on purpose: the router must see a torn
+                # connection, not an HTTP error
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.die.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_stitched_trace_hedge_and_failover_during_replica_kill(tmp_path):
+    """The fleet-observability acceptance drill (docs "Observability"):
+    ONE request that hedges AND fails over while its primary replica is
+    killed mid-request, reconstructed after the fact as a single
+    stitched trace — router events (pick, hedge_fire, attempt_fail,
+    failover, attempt_ok) merged with the winning replica's span
+    payload under one X-Request-Id — served from ``/debug/trace/<id>``
+    and force-captured into ``access.jsonl`` by tail sampling (the
+    sample rate is far too coarse to have caught it by chance), with
+    the response itself still bit-identical to the direct oracle."""
+    access = tmp_path / "access.jsonl"
+    victim = _KillableReplica()
+    sink = _StubReplica(mode="e503")  # the hedge target: fails fast
+    servers, router, close = _start_fleet(
+        n=1, probe_interval=30.0, failover_retries=3,
+        hedge_after_s=0.05, trace_ring=64,
+        access_log=str(access), access_log_sample=1000,
+    )
+    from trlx_tpu.router import Backend
+
+    try:
+        want = _oracle_rows(servers[0].engine)
+        # request #1 — sampled (the access log always records the first
+        # request) — warms the path while the fleet is still healthy
+        status, _, body = _http(
+            router.port, "/generate", "POST",
+            {"tokens": ROWS[0], "max_new_tokens": MAX_NEW},
+        )
+        assert status == 200 and body["tokens"] == want[0]
+
+        with router._lock:
+            live_b = router.backends[0]
+            victim_b = Backend(f"127.0.0.1:{victim.port}",
+                               CircuitBreaker(8, 60.0))
+            sink_b = Backend(f"127.0.0.1:{sink.port}",
+                             CircuitBreaker(8, 60.0))
+            for b in (victim_b, sink_b):
+                b.admitted = True
+                b.ever_admitted = True
+                router.backends.append(b)
+            # pin the drill prompt on the victim, and make the live
+            # replica look loaded so the hedge deterministically lands
+            # on the e503 sink (probes are parked for the whole test,
+            # so neither override is overwritten mid-drill)
+            router.affinity.insert(ROWS[1], victim_b)
+            live_b.queue_depth = 8
+
+        tid = "feedfacecafe0042"
+        out = {}
+
+        def fire():
+            out["resp"] = _http(
+                router.port, "/generate", "POST",
+                {"tokens": ROWS[1], "max_new_tokens": MAX_NEW},
+                headers={"X-Request-Id": tid},
+            )
+
+        t = threading.Thread(target=fire)
+        t.start()
+        assert victim.in_flight.wait(10.0), \
+            "primary attempt never reached the victim"
+        deadline = time.monotonic() + 10.0
+        while sink.generate_calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # hedge fires max(p95, 50ms) after pick
+        assert sink.generate_calls >= 1, "hedge never fired on the sink"
+        victim.stop()  # the kill: primary's socket drops mid-request
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "drill request never completed"
+
+        status, headers, body = out["resp"]
+        assert status == 200, body
+        assert body["tokens"] == want[1], \
+            "the failover response must stay bit-identical to the oracle"
+        assert headers.get("X-Request-Id") == tid
+
+        # ONE stitched record out of the ring: both router iterations
+        # (hedged race, then failover) and the winning replica's span
+        status, _, rec = _http(router.port, f"/debug/trace/{tid}")
+        assert status == 200, rec
+        assert rec["trace_id"] == tid
+        assert rec["status"] == 200
+        assert rec["hedged"] and rec["failed_over"], rec
+        assert rec["backend"] == live_b.url
+        names = [e["event"] for e in rec["events"]]
+        for needed in ("pick", "attempt", "hedge_fire", "attempt_fail",
+                       "retry_budget_spend", "failover", "attempt_ok"):
+            assert needed in names, f"missing {needed} in {names}"
+        first_pick = next(e for e in rec["events"] if e["event"] == "pick")
+        assert first_pick["backend"] == victim_b.url
+        assert first_pick["how"] == "affinity"
+        hedge = next(e for e in rec["events"]
+                     if e["event"] == "hedge_fire")
+        assert hedge["backend"] == sink_b.url
+        ok_ev = next(e for e in rec["events"]
+                     if e["event"] == "attempt_ok")
+        assert ok_ev["backend"] == live_b.url
+        assert isinstance(rec.get("replica"), dict), \
+            "the winning replica's span must ride in the same record"
+        assert rec["replica"]["trace_id"] == tid
+        assert rec["replica"]["ttft_ms"] > 0
+        status, _, listing = _http(router.port, "/debug/trace")
+        assert status == 200 and tid in listing["traces"]
+
+        # tail capture: sample_every=1000 admits only request #1 by
+        # count; the drill is request #2 and lands anyway because its
+        # hedged/failed-over flags force the write
+        records = obslib.read_records(str(access))
+        assert len(records) == 2, [r.get("trace_id") for r in records]
+        tail = obslib.find_record(records, tid)
+        assert tail is not None, "the drill must be tail-captured"
+        assert tail["hedged"] and tail["failed_over"]
+        assert tail["status"] == 200
+    finally:
+        victim.stop()
+        sink.stop()
         close()

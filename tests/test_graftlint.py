@@ -57,6 +57,7 @@ SIMPLE = [
     ("guarded-by", "locks/guarded_by", LIB),
     ("guarded-by-unknown", "locks/guarded_by_unknown", LIB),
     ("metric-dynamic-name", "contracts/metric_dynamic_name", LIB),
+    ("metric-name-literal", "contracts/metric_name_literal", LIB),
     ("http-timeout-required", "contracts/http_timeout_required", LIB),
     ("race-detected", "concurrency/race_helper", LIB),
     ("race-detected", "concurrency/race_contract", LIB),
@@ -112,6 +113,16 @@ def test_adhoc_timing_allowed_where_timing_is_the_job():
             select=["adhoc-timing"],
         )
         assert findings == [], path
+
+
+def test_metric_name_literal_allows_telemetry_plumbing():
+    # the registry's own wrappers forward computed names by design
+    findings = lint(
+        {"trlx_tpu/telemetry/mod.py":
+         fixture("contracts/metric_name_literal_bad.py")},
+        select=["metric-name-literal"],
+    )
+    assert findings == []
 
 
 def test_serve_clock_only_fires_under_serve():

@@ -1,0 +1,578 @@
+"""Fleet observability plane tests (`make obs`): labeled metric storage
++ Prometheus exposition (label sets, the cumulative ``_hist`` bucket
+family, sanitize-collision disambiguation, empty-registry rendering),
+the SLO window/burn-rate engine and its liveness acceptance (a
+TTFT-breach burst moves the windowed gauges while lifetime
+``serve/goodput`` barely moves), stitched fleet traces (FleetTrace /
+TraceRing / AccessLog sampling + tail capture + rotation), the
+trainer-trace size cap, the ``telemetry: false`` records-nothing
+contract, the obs CLI (in-process units + a subprocess smoke run over
+the fixture ``access.jsonl``), and the router's ``/debug/trace`` /
+``/debug/slo`` endpoints over stub replicas.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import trlx_tpu.obs as obslib
+from trlx_tpu import telemetry
+from trlx_tpu.obs.__main__ import main as obs_main
+from trlx_tpu.router import FleetRouter, RouterConfig
+from trlx_tpu.router.obs import (
+    AccessLog,
+    FleetTrace,
+    RouterObs,
+    TraceRing,
+    is_tail,
+)
+from trlx_tpu.serve.trace import RequestTrace, SloEngine, SloWindow, \
+    slo_engine
+from trlx_tpu.telemetry import prometheus
+from trlx_tpu.telemetry.registry import (
+    BUCKET_BOUNDS,
+    MetricsRegistry,
+    label_key,
+    split_label_key,
+)
+from test_defense import _StubReplica
+from test_router import _http
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURE_LOG = str(REPO / "tests" / "obs_fixtures" / "access.jsonl")
+
+
+@pytest.fixture()
+def fresh_registry():
+    session = telemetry.start()
+    yield session.registry
+    telemetry.start()
+
+
+# --------------------------------------------------------------------- #
+# labeled registry storage
+# --------------------------------------------------------------------- #
+
+
+def test_label_key_roundtrip_and_sorting():
+    key = label_key("serve/x", {"b": 2, "a": "one"})
+    assert key == "serve/x{a=one,b=2}"
+    # same label set, any insertion order -> the same series
+    assert key == label_key("serve/x", {"a": "one", "b": 2})
+    base, labels = split_label_key(key)
+    assert base == "serve/x"
+    assert labels == {"a": "one", "b": "2"}  # values come back as str
+    assert split_label_key("serve/plain") == ("serve/plain", {})
+    assert label_key("serve/plain", None) == "serve/plain"
+
+
+def test_registry_stores_labeled_series_as_flat_keys(fresh_registry):
+    reg = fresh_registry
+    reg.inc("router/picked", labels={"how": "affinity"})
+    reg.inc("router/picked", 2.0, labels={"how": "fallback"})
+    reg.set_gauge("slo/goodput_5m", 0.5, labels={"path": "slots"})
+    reg.observe("serve/request_latency", 0.1, labels={"path": "slots"})
+    assert reg.counters["router/picked{how=affinity}"] == 1.0
+    assert reg.counters["router/picked{how=fallback}"] == 2.0
+    assert reg.gauges["slo/goodput_5m{path=slots}"] == 0.5
+    assert reg.hists["serve/request_latency{path=slots}"].count == 1
+    # flat-dict consumers (trackers) see labeled series as plain keys
+    stats = reg.tracker_stats()
+    assert stats["router/picked{how=affinity}"] == 1.0
+
+
+def test_hist_buckets_cumulative_and_inf():
+    reg = MetricsRegistry()
+    for s in (0.0005, 0.02, 0.02, 0.07, 500.0):  # 500 s: over the max
+        reg.observe("serve/ttft", s)
+    hist = reg.hists["serve/ttft"]
+    cum = hist.cumulative_buckets()
+    assert [b for b, _ in cum] == list(BUCKET_BOUNDS)
+    counts = [c for _, c in cum]
+    # cumulative: monotone non-decreasing
+    assert all(a <= b for a, b in zip(counts, counts[1:]))
+    # the over-max observation is only in +Inf (== count)
+    assert counts[-1] == 4
+    assert hist.count == 5
+    # the first observation is in the buckets too (unlike the quantile
+    # window, which holds it apart as the compile-laden first call)
+    assert counts[0] == 1  # 0.0005 <= 0.001
+
+
+# --------------------------------------------------------------------- #
+# Prometheus exposition: labels, _hist family, collisions, empty
+# --------------------------------------------------------------------- #
+
+
+def test_render_labeled_families_share_one_type_header():
+    reg = MetricsRegistry()
+    reg.inc("router/picked", labels={"how": "affinity"})
+    reg.inc("router/picked", labels={"how": "fallback"})
+    reg.set_gauge("slo/goodput_5m", 0.25, labels={"path": "slots"})
+    text = prometheus.render(reg)
+    assert text.count("# TYPE trlx_tpu_router_picked_total counter") == 1
+    assert 'trlx_tpu_router_picked_total{how="affinity"} 1.0' in text
+    assert 'trlx_tpu_router_picked_total{how="fallback"} 1.0' in text
+    assert 'trlx_tpu_slo_goodput_5m{path="slots"} 0.25' in text
+
+
+def test_render_hist_bucket_family_monotone_with_inf():
+    reg = MetricsRegistry()
+    for s in (0.002, 0.02, 0.02, 900.0):
+        reg.observe("serve/ttft", s, labels={"path": "slots"})
+    text = prometheus.render(reg)
+    assert "# TYPE trlx_tpu_serve_ttft_seconds summary" in text
+    assert "# TYPE trlx_tpu_serve_ttft_seconds_hist histogram" in text
+    bucket_lines = [
+        line for line in text.splitlines()
+        if line.startswith("trlx_tpu_serve_ttft_seconds_hist_bucket")
+    ]
+    assert len(bucket_lines) == len(BUCKET_BOUNDS) + 1  # + le="+Inf"
+    values = [float(line.rsplit(" ", 1)[1]) for line in bucket_lines]
+    assert all(a <= b for a, b in zip(values, values[1:]))
+    assert bucket_lines[-1].startswith(
+        'trlx_tpu_serve_ttft_seconds_hist_bucket{le="+Inf",path="slots"}'
+    ) or 'le="+Inf"' in bucket_lines[-1]
+    assert values[-1] == 4.0  # +Inf == count, over-max included
+    assert "trlx_tpu_serve_ttft_seconds_hist_count" in text
+    assert "trlx_tpu_serve_ttft_seconds_hist_sum" in text
+
+
+def test_render_empty_registry_is_valid_and_sampleless():
+    text = prometheus.render(MetricsRegistry())
+    assert text == "\n"
+
+
+def test_sanitized_names_disambiguate_collisions():
+    names = prometheus.sanitized_names(
+        ["serve/ttft", "serve.ttft", "serve:ttft", "serve/itl"]
+    )
+    sanitized = sorted(names.values())
+    assert len(set(sanitized)) == 4, "no two raw names may merge"
+    # sorted raw order: 'serve.ttft' < 'serve/ttft' < 'serve:ttft'
+    assert names["serve.ttft"] == "trlx_tpu_serve_ttft"
+    assert names["serve/ttft"] == "trlx_tpu_serve_ttft_dup2"
+    assert names["serve:ttft"] == "trlx_tpu_serve:ttft"
+    assert names["serve/itl"] == "trlx_tpu_serve_itl"
+
+
+def test_render_collision_yields_distinct_series():
+    reg = MetricsRegistry()
+    reg.inc("serve/dup", 1.0)
+    reg.inc("serve.dup", 2.0)
+    text = prometheus.render(reg)
+    assert "trlx_tpu_serve_dup_total 2.0" in text  # '.' sorts first
+    assert "trlx_tpu_serve_dup_dup2_total 1.0" in text
+
+
+# --------------------------------------------------------------------- #
+# SLO windows + burn-rate engine
+# --------------------------------------------------------------------- #
+
+
+def test_slo_window_counts_and_expiry():
+    win = SloWindow(fast_s=10.0, slow_s=100.0, buckets=100)
+    for t in range(20):
+        win.record(t % 2 == 0, now=float(t))
+    good, total = win.counts(100.0, now=19.0)
+    assert (good, total) == (10, 20)
+    good, total = win.counts(10.0, now=19.0)
+    assert total <= 11 and total >= 9  # bucket-granular trailing 10 s
+    # far-future write expires everything older than the slow window
+    win.record(True, now=500.0)
+    good, total = win.counts(100.0, now=500.0)
+    assert (good, total) == (1, 1)
+
+
+def test_slo_engine_empty_window_is_not_an_outage():
+    eng = SloEngine(target=0.99, fast_s=1.0, slow_s=10.0)
+    snap = eng.snapshot(now=0.0)
+    assert snap["series"] == []
+    assert snap["target"] == 0.99
+    assert eng.burn_rate(1.0) == 0.0
+    assert eng.burn_rate(0.5) == pytest.approx(50.0)
+
+
+def test_slo_engine_sets_labeled_gauges(fresh_registry):
+    eng = SloEngine(target=0.9, fast_s=10.0, slow_s=100.0)
+    eng.record(True, now=1.0, labels={"backend": "b1"})
+    eng.record(False, now=2.0, labels={"backend": "b1"})
+    gauges = fresh_registry.gauges
+    assert gauges["slo/goodput_5m{backend=b1}"] == 0.5
+    assert gauges["slo/burn_rate_fast{backend=b1}"] == pytest.approx(5.0)
+    (series,) = eng.snapshot(now=2.0)["series"]
+    assert series["labels"] == {"backend": "b1"}
+    assert series["good_fast"] == 1 and series["total_fast"] == 2
+    assert series["burn_rate_fast"] == pytest.approx(5.0)
+
+
+def _completed_trace(received, ttft_s, path="slots", slo_ttft_s=0.05):
+    tr = RequestTrace(received=received)
+    tr.enqueued = received
+    tr.admitted = received + 0.001
+    tr.first_token = received + ttft_s
+    tr.harvested = received + ttft_s + 0.01
+    tr.complete(path, slo_ttft_s)
+
+
+def test_slo_liveness_burst_moves_fast_window_not_lifetime(
+    fresh_registry,
+):
+    """The acceptance drill: 180 good completions of history, then a
+    20-request TTFT-breach burst. The fast windowed gauges swing within
+    one window while lifetime serve/goodput barely moves — and both
+    shapes are in the Prometheus text with their labels."""
+    tel = telemetry.current()
+    tel.slo = SloEngine(target=0.99, fast_s=1.0, slow_s=1000.0)
+    for i in range(180):
+        _completed_trace(1500.0 + i * 0.05, ttft_s=0.01)  # good
+    assert fresh_registry.gauges["serve/goodput"] == 1.0
+    assert fresh_registry.gauges["slo/goodput_5m{path=slots}"] == 1.0
+    for i in range(20):
+        _completed_trace(2000.0 + i * 0.02, ttft_s=0.5)  # breach
+    gauges = fresh_registry.gauges
+    # fast window: only the burst is inside it -> goodput 0, burn 100x
+    assert gauges["slo/goodput_5m{path=slots}"] == 0.0
+    assert gauges["slo/burn_rate_fast{path=slots}"] == pytest.approx(100.0)
+    # slow window spans the good history too
+    assert gauges["slo/goodput_1h{path=slots}"] == pytest.approx(0.9)
+    # lifetime goodput barely moved off 1.0
+    assert gauges["serve/goodput"] == pytest.approx(0.9)
+    assert gauges["serve/goodput"] > 0.85
+    text = telemetry.prometheus_text()
+    assert 'trlx_tpu_slo_goodput_5m{path="slots"} 0.0' in text
+    (burn_line,) = [
+        line for line in text.splitlines()
+        if line.startswith('trlx_tpu_slo_burn_rate_fast{path="slots"}')
+    ]
+    assert float(burn_line.rsplit(" ", 1)[1]) == pytest.approx(100.0)
+    assert "trlx_tpu_serve_goodput 0.9" in text
+
+
+# --------------------------------------------------------------------- #
+# stitched traces: FleetTrace / TraceRing / AccessLog
+# --------------------------------------------------------------------- #
+
+
+def test_fleet_trace_events_flags_and_finish(fresh_registry):
+    ft = FleetTrace("abc123", started=0.0)
+    ft.event("pick", backend="b1", how="affinity", depth=2)
+    ft.event("hedge_fire", backend="b2")
+    ft.event("failover", n=1)
+    ft.event("breaker_open", backend="b1")
+    record = ft.finish(
+        200, backend="b2",
+        replica_trace={"ttft_ms": 80.0, "decode_ms": 40.0},
+        slo_ttft_ms=50.0,
+    )
+    assert record["trace_id"] == "abc123"
+    assert record["hedged"] and record["failed_over"]
+    assert record["breaker_opened"]
+    assert record["slo_breached"]  # 80 ms > 50 ms objective
+    assert [e["event"] for e in record["events"]] == [
+        "pick", "hedge_fire", "failover", "breaker_open",
+    ]
+    assert all(e["t_ms"] >= 0.0 for e in record["events"])
+    assert record["replica"]["decode_ms"] == 40.0
+    assert is_tail(record)
+    clean = FleetTrace("d", started=0.0).finish(
+        200, backend="b1", replica_trace={"ttft_ms": 10.0},
+        slo_ttft_ms=50.0,
+    )
+    assert not is_tail(clean)
+    assert is_tail({"status": 503})
+
+
+def test_trace_ring_bounds_and_newest_first():
+    ring = TraceRing(capacity=2)
+    for i in range(3):
+        ring.put({"trace_id": f"t{i}"})
+    assert ring.get("t0") is None, "oldest evicted at capacity"
+    assert ring.get("t2") == {"trace_id": "t2"}
+    assert ring.ids() == ["t2", "t1"]
+    ring.put({"trace_id": "t1", "status": 200})  # re-capture: newest wins
+    assert ring.ids() == ["t1", "t2"]
+    assert ring.get("t1")["status"] == 200
+
+
+def test_access_log_sampling_tail_capture_and_rotation(tmp_path):
+    path = str(tmp_path / "access.jsonl")
+    log = AccessLog(path, sample_every=3, max_bytes=10_000)
+    assert log.write({"trace_id": "r1"}) is True  # first always lands
+    assert log.write({"trace_id": "r2"}) is False
+    assert log.write({"trace_id": "r3"}) is False
+    assert log.write({"trace_id": "r4"}) is True  # every 3rd thereafter
+    assert log.write({"trace_id": "r5", "status": 503},
+                     force=True) is True  # tail capture beats sampling
+    ids = [r["trace_id"] for r in obslib.read_records(path)]
+    assert ids == ["r1", "r4", "r5"]
+    assert log.stats() == {"seen": 5, "sampled_out": 2}
+    # size-based rotation: the full file moves to .1, appends restart
+    small = AccessLog(str(tmp_path / "rot.jsonl"), sample_every=1,
+                      max_bytes=120)
+    for i in range(6):
+        small.write({"trace_id": f"x{i}", "pad": "p" * 40})
+    assert os.path.exists(str(tmp_path / "rot.jsonl") + ".1")
+    assert os.path.getsize(str(tmp_path / "rot.jsonl")) <= 120
+
+
+def test_trainer_trace_jsonl_respects_max_bytes(tmp_path):
+    session = telemetry.start()
+    try:
+        for i in range(200):
+            with telemetry.span(f"phase_{i % 4}"):
+                pass
+        path = str(tmp_path / "trace.jsonl")
+        session.tracer.write_jsonl(path, max_bytes=2048)
+        assert os.path.getsize(path) <= 2048 + 256  # + dropped marker
+        lines = [json.loads(line) for line in open(path)]
+        assert lines, "the recent tail survives the cap"
+        assert "events dropped" in lines[-1]["name"]
+    finally:
+        telemetry.start()
+
+
+def test_telemetry_off_records_nothing(tmp_path):
+    telemetry.stop()
+    try:
+        assert telemetry.current() is None
+        telemetry.inc("serve/requests")
+        telemetry.set_gauge("slo/goodput_5m", 0.5, labels={"a": "b"})
+        telemetry.observe("serve/ttft", 0.1)
+        assert slo_engine() is None
+        obs = RouterObs(trace_ring=8,
+                        access_log=str(tmp_path / "a.jsonl"))
+        assert obs.begin("t1") is None, (
+            "telemetry: false must disable stitched tracing too"
+        )
+        assert obs.finish(None, 200) is None
+        assert not os.path.exists(str(tmp_path / "a.jsonl"))
+    finally:
+        telemetry.start()
+
+
+# --------------------------------------------------------------------- #
+# the obs library: summarize / perfetto / tail formatting
+# --------------------------------------------------------------------- #
+
+
+def test_read_records_skips_torn_lines():
+    records = obslib.read_records(FIXTURE_LOG)
+    assert len(records) == 5, "the torn fixture line is skipped"
+
+
+def test_summarize_fixture_totals_and_backends():
+    report = obslib.summarize(obslib.read_records(FIXTURE_LOG))
+    totals = report["totals"]
+    assert totals["requests"] == 5
+    assert totals["errors"] == 1
+    assert totals["slo_breached"] == 1
+    assert totals["hedged"] == 1
+    assert totals["hedge_wins"] == 1
+    assert totals["hedge_win_rate"] == 1.0
+    assert totals["failovers"] == 1
+    assert totals["breaker_strikes"] == 2
+    assert totals["breaker_opens"] == 1
+    assert totals["retry_tokens_spent"] == 2
+    b1 = report["backends"]["http://127.0.0.1:8081"]
+    assert b1["requests"] == 2
+    assert b1["ttft_p50_ms"] in (18.0, 25.0)
+    rendered = obslib.format_summary(report)
+    assert "hedge_wins 1" in rendered
+    assert "http://127.0.0.1:8082" in rendered
+
+
+def test_percentile_and_find_record():
+    assert obslib.percentile([], 0.5) == 0.0
+    assert obslib.percentile([3.0, 1.0, 2.0], 0.5) == 2.0
+    records = [{"trace_id": "a", "v": 1}, {"trace_id": "a", "v": 2}]
+    assert obslib.find_record(records, "a")["v"] == 2  # latest wins
+    assert obslib.find_record(records, "zz") is None
+
+
+def test_perfetto_events_reconstruct_both_tracks():
+    record = obslib.find_record(
+        obslib.read_records(FIXTURE_LOG), "feedbeefcafe0001"
+    )
+    events = obslib.perfetto_events(record)
+    for e in events:
+        assert e["ph"] in ("M", "X", "i")
+        if e["ph"] != "M":
+            assert e["ts"] >= 0.0
+    (span,) = [e for e in events
+               if e["name"].startswith("fleet/request")]
+    assert span["dur"] == pytest.approx(912.4 * 1000.0)
+    instants = [e for e in events if e["ph"] == "i"]
+    assert {"router/hedge_fire", "router/failover"} <= {
+        e["name"] for e in instants
+    }
+    replica = [e for e in events
+               if e["ph"] == "X" and e.get("tid") == 1]
+    assert [e["name"] for e in replica] == [
+        "replica/queue", "replica/prefill", "replica/decode",
+    ]
+    # replica phases anchor at the winning backend's attempt and lay
+    # out end to end
+    anchor_us = 150.6 * 1000.0
+    assert replica[0]["ts"] == pytest.approx(anchor_us)
+    assert replica[1]["ts"] == pytest.approx(
+        anchor_us + replica[0]["dur"]
+    )
+
+
+def test_format_line_flags_and_colors():
+    records = {r["trace_id"]: r
+               for r in obslib.read_records(FIXTURE_LOG)}
+    tail = obslib.format_line(records["feedbeefcafe0001"], color=False)
+    assert "SHF-" in tail and "\x1b" not in tail
+    assert obslib.format_line(
+        records["feedbeefcafe0001"], color=True
+    ).startswith("\x1b[33m")  # breach/hedge -> yellow
+    err = obslib.format_line(records["feedbeefcafe0002"], color=True)
+    assert err.startswith("\x1b[31m")  # non-200 -> red
+    assert "HTTP 503" in err
+    clean = obslib.format_line(records["aaaa000011112222"], color=True)
+    assert "\x1b" not in clean and "----" in clean
+
+
+# --------------------------------------------------------------------- #
+# the obs CLI: in-process units + a real subprocess smoke
+# --------------------------------------------------------------------- #
+
+
+def test_cli_summarize_json_and_text(capsys):
+    assert obs_main(["summarize", FIXTURE_LOG, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["totals"]["requests"] == 5
+    assert obs_main(["summarize", FIXTURE_LOG]) == 0
+    assert "hedge_wins" in capsys.readouterr().out
+
+
+def test_cli_trace_prints_timeline_and_misses_cleanly(capsys):
+    assert obs_main(["trace", "feedbeefcafe0001",
+                     "--log", FIXTURE_LOG, "--no-color"]) == 0
+    out = capsys.readouterr().out
+    assert "hedge_fire" in out and "failover" in out
+    assert "replica:" in out and "ttft_ms=620.0" in out
+    assert obs_main(["trace", "nope", "--log", FIXTURE_LOG]) == 1
+    assert "no stitched trace" in capsys.readouterr().err
+
+
+def test_cli_trace_perfetto_export(tmp_path, capsys):
+    out = str(tmp_path / "stitched.json")
+    assert obs_main(["trace", "feedbeefcafe0001", "--log", FIXTURE_LOG,
+                     "--perfetto", "-o", out]) == 0
+    events = json.load(open(out))["traceEvents"]
+    assert any(e["name"] == "router/hedge_fire" for e in events)
+    assert any(e["name"] == "replica/decode" for e in events)
+
+
+def test_cli_tail_no_follow(capsys):
+    assert obs_main(["tail", FIXTURE_LOG, "-n", "3",
+                     "--no-follow", "--no-color"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    # -n slices raw backlog lines; the torn fixture line is skipped
+    assert len(lines) == 2
+    assert lines[-1].split()[0] == "feedbeefcafe0002"
+
+
+def test_cli_subprocess_smoke():
+    """The `make obs` acceptance: the CLI works as an actual program
+    against the fixture log, stdlib-fast, no JAX warmup required."""
+    out = subprocess.run(
+        [sys.executable, "-m", "trlx_tpu.obs", "summarize", FIXTURE_LOG],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "requests 5" in out.stdout
+    tail = subprocess.run(
+        [sys.executable, "-m", "trlx_tpu.obs", "tail", FIXTURE_LOG,
+         "--no-follow", "--no-color"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert tail.returncode == 0, tail.stderr
+    assert "feedbeefcafe0001" in tail.stdout
+
+
+# --------------------------------------------------------------------- #
+# router integration: /debug/trace + /debug/slo + access log over stubs
+# --------------------------------------------------------------------- #
+
+
+def test_router_stitched_trace_endpoints_and_access_log(tmp_path):
+    stubs = [_StubReplica(), _StubReplica()]
+    telemetry.start()
+    log_path = str(tmp_path / "access.jsonl")
+    router = FleetRouter(RouterConfig(
+        backends=[f"127.0.0.1:{s.port}" for s in stubs], port=0,
+        page_size=64, probe_interval=30.0, probe_timeout=5.0,
+        request_timeout=10.0, failover_backoff=0.01,
+        trace_ring=8, access_log=log_path, access_log_sample=1,
+    )).start()
+    try:
+        tid = "feedfacecafebeef"
+        status, payload, headers = router.forward(
+            {"tokens": [1, 2, 3], "max_new_tokens": 1}, trace_id=tid
+        )
+        assert status == 200
+        # the stitched record is behind GET /debug/trace/<id>
+        st, _, body = _http(router.port, f"/debug/trace/{tid}")
+        assert st == 200
+        assert body["trace_id"] == tid and body["status"] == 200
+        kinds = [e["event"] for e in body["events"]]
+        assert "pick" in kinds and "attempt_ok" in kinds
+        st, _, listing = _http(router.port, "/debug/trace")
+        assert st == 200 and tid in listing["traces"]
+        st, _, miss = _http(router.port, "/debug/trace/unknown00")
+        assert st == 404 and "error" in miss
+        # the per-backend SLO windows are live on /debug/slo
+        st, _, slo = _http(router.port, "/debug/slo")
+        assert st == 200 and slo["series"], "one routed request scored"
+        assert all("backend" in s["labels"] for s in slo["series"])
+        # sampled (sample_every=1) into the access log
+        records = obslib.read_records(log_path)
+        assert any(r["trace_id"] == tid for r in records)
+        # /metrics negotiation on the router: JSON default, text on
+        # Accept — with the slo/* family labeled per backend
+        st, _, metrics = _http(router.port, "/metrics")
+        assert st == 200 and "counters" in metrics
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/metrics",
+            headers={"Accept": "text/plain"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "trlx_tpu_router_requests_total 1.0" in text
+        assert 'trlx_tpu_slo_goodput_5m{backend="http://' in text
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+        telemetry.start()
+
+
+def test_router_trace_ring_disabled_is_a_typed_404(tmp_path):
+    stub = _StubReplica()
+    telemetry.start()
+    router = FleetRouter(RouterConfig(
+        backends=[f"127.0.0.1:{stub.port}"], port=0, page_size=64,
+        probe_interval=30.0, probe_timeout=5.0, request_timeout=10.0,
+        trace_ring=0, access_log="",
+    )).start()
+    try:
+        st, _, body = _http(router.port, "/debug/trace/whatever")
+        assert st == 404
+        assert "disabled" in body["error"]
+        # /debug/slo still answers (empty until traffic flows)
+        st, _, slo = _http(router.port, "/debug/slo")
+        assert st == 200 and "series" in slo
+    finally:
+        router.stop()
+        stub.stop()
+        telemetry.start()

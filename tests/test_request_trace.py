@@ -107,9 +107,10 @@ def test_timing_hist_p95_with_ties():
 # Prometheus exposition
 # --------------------------------------------------------------------- #
 
-# one exposition sample: name{optional labels} float
+# one exposition sample: name{optional comma-joined labels} float
 _SAMPLE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"\})? "
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z0-9_]+=\"[^\"]*\"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? "
     r"-?\d+(\.\d+)?([eE][+-]?\d+)?$"
 )
 
@@ -132,7 +133,8 @@ def test_prometheus_render_schema():
         if line.startswith("# TYPE "):
             parts = line.split()
             assert len(parts) == 4
-            assert parts[3] in ("counter", "gauge", "summary")
+            assert parts[3] in ("counter", "gauge", "summary",
+                                "histogram")
         else:
             assert _SAMPLE.match(line), f"malformed sample line: {line!r}"
     assert "# TYPE trlx_tpu_serve_requests_total counter" in text
@@ -202,8 +204,8 @@ def test_trace_itl_aggregation_and_ttft(fresh_registry):
         pytest.approx(0.1, abs=1e-9)
     assert fresh_registry.hists["serve/decode_time"].last == \
         pytest.approx(1.1)
-    assert fresh_registry.hists["serve/request_latency_slots"].last == \
-        pytest.approx(1.7)
+    assert fresh_registry.hists["serve/request_latency{path=slots}"] \
+        .last == pytest.approx(1.7)
     assert fresh_registry.gauges["serve/goodput"] == 1.0
 
     d = tr.to_dict()
@@ -352,7 +354,8 @@ def test_slots_requests_carry_complete_traces(scheduler, fresh_registry):
     assert fresh_registry.hists["serve/queue_time"].count == 3
     assert fresh_registry.hists["serve/prefill_time"].count == 3
     assert fresh_registry.hists["serve/decode_time"].count == 3
-    assert fresh_registry.hists["serve/request_latency_slots"].count == 3
+    assert fresh_registry.hists[
+        "serve/request_latency{path=slots}"].count == 3
     # slo_ttft_ms=0 -> everything counts good
     assert fresh_registry.gauges["serve/goodput"] == 1.0
     # deprecated end-to-end histogram still emits for dashboards

@@ -227,7 +227,8 @@ def test_static_path_populates_request_trace(engine, fresh_registry,
         assert tr.itl_min == tr.itl_max  # uniform approximation
     # complete("static", ...) derived the SLO family + per-path latency
     assert fresh_registry.hists["serve/ttft"].count == 1
-    assert fresh_registry.hists["serve/request_latency_static"].count == 1
+    assert fresh_registry.hists[
+        "serve/request_latency{path=static}"].count == 1
     assert "serve/goodput" in fresh_registry.gauges
 
 

@@ -17,10 +17,10 @@ from trlx_tpu.analysis.model import FileContext, _const_strings
 
 #: counter namespaces under the predeclaration contract
 _COUNTER_PREFIXES = ("serve/", "fault/", "checkpoint/", "chaos/",
-                     "telemetry/", "compile/", "router/")
+                     "telemetry/", "compile/", "router/", "slo/")
 
 #: namespaces the observability.rst catalog must cover
-_DOC_PREFIXES = ("serve/", "fault/", "router/", "checkpoint/")
+_DOC_PREFIXES = ("serve/", "fault/", "router/", "checkpoint/", "slo/")
 
 _EMITTERS = ("inc", "set_gauge", "observe")
 
@@ -160,6 +160,60 @@ class MetricDynamicNameRule(LibraryRule):
                     f"dynamic metric name f\"{head.value}...\" — names "
                     f"in serve//fault/ must be static literals",
                 )
+
+
+@register
+class MetricNameLiteralRule(LibraryRule):
+    id = "metric-name-literal"
+    family = "contracts"
+    rationale = (
+        "with labels in the registry, the varying part of a metric "
+        "belongs in the label dict, never in the name: a name built at "
+        "the call site (f-string, concatenation, %-format, .format()) "
+        "is invisible to the predeclaration and catalog contracts even "
+        "when it never varies, and one loop variable away from "
+        "unbounded series cardinality — every inc/set_gauge/observe "
+        "outside trlx_tpu/telemetry/ must pass its name as a literal "
+        "(or a variable bound to one)"
+    )
+    hint = (
+        "pass a string literal and move the varying part into "
+        "labels={...}, e.g. observe('serve/request_latency', dt, "
+        "labels={'path': path})"
+    )
+
+    #: the registry's own plumbing legitimately forwards computed
+    #: names (tracer time/<phase> spans, device gauges)
+    _EXEMPT = "trlx_tpu/telemetry/"
+
+    def check(self, ctx, project):
+        if ctx.path.startswith(self._EXEMPT):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_leaf(node) not in _EMITTERS:
+                continue
+            if not node.args:
+                continue
+            how = self._constructed(node.args[0])
+            if how is None:
+                continue
+            yield self.finding(
+                ctx, node.lineno,
+                f"metric name built with {how} at the emit site — pass "
+                f"a literal name and put the varying part in labels=",
+            )
+
+    @staticmethod
+    def _constructed(arg: ast.expr) -> Optional[str]:
+        if isinstance(arg, ast.JoinedStr):
+            return "an f-string"
+        if isinstance(arg, ast.BinOp):
+            return "+ / % string construction"
+        if isinstance(arg, ast.Call) and _callee_leaf(arg) == "format":
+            return "a .format() call"
+        return None
 
 
 #: outbound-HTTP constructors/calls that accept (and must be passed) an

@@ -77,7 +77,15 @@ operable as one unit. Four pieces:
   metric family (predeclared, docs "Observability") on the router's own
   ``/metrics`` — JSON summary or Prometheus text exposition via the same
   content negotiation as the engines — plus a fleet ``/healthz`` with
-  per-backend state. A backend advertising the degraded-mode signal
+  per-backend state. Every request also builds a STITCHED fleet trace
+  (trlx_tpu.router.obs): the router's own pick/hedge/failover/breaker
+  event timeline merged with the winning replica's ``trace`` payload
+  under one ``X-Request-Id``, served from a bounded ring at
+  ``GET /debug/trace/<id>`` and sampled into a rotated ``access.jsonl``
+  (tail-based always-capture for SLO-breach/error/hedge/failover;
+  ``python -m trlx_tpu.obs`` reads it). Windowed per-backend goodput +
+  burn-rate gauges (``slo/*``, serve.trace.SloEngine) live at
+  ``GET /debug/slo``. A backend advertising the degraded-mode signal
   (``serve.degrade_step_ms``) has its share halved in the least-loaded
   fallback (its effective queue depth doubles), so a sick replica sheds
   load before it stalls.
@@ -103,12 +111,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from trlx_tpu import supervisor, telemetry
+from trlx_tpu.router.obs import FleetTrace, RouterObs
 from trlx_tpu.router.resilience import (
     CircuitBreaker,
     LatencyWindow,
     RetryBudget,
 )
-from trlx_tpu.serve.trace import new_trace_id
+from trlx_tpu.serve.trace import new_trace_id, slo_engine
 from trlx_tpu.supervisor import RunSupervisor, chaos, monotonic
 
 #: the router/* counter family, predeclared at start() so a scrape sees
@@ -212,6 +221,19 @@ class RouterConfig:
     #: request on a second replica after max(floor, rolling p95 of
     #: recent request latencies) — first response wins
     hedge_after_s: float = 0.0
+    #: stitched-trace ring capacity behind ``GET /debug/trace/<id>``
+    #: (trlx_tpu.router.obs; 0 disables per-request fleet tracing)
+    trace_ring: int = 256
+    #: path for the sampled access log of stitched traces ("" disables)
+    access_log: str = ""
+    #: write every Nth healthy request to the access log (1 = all);
+    #: tail captures (SLO breach / error / hedge / failover) always land
+    access_log_sample: int = 20
+    #: access-log rotation budget in MB (renamed to ``<path>.1`` over it)
+    access_log_max_mb: float = 64.0
+    #: goodput objective the windowed SLO engine scores burn rates
+    #: against (slo/burn_rate_* gauges; docs "Observability", runbook)
+    slo_target: float = 0.99
 
     def __post_init__(self):
         if not self.backends:
@@ -242,6 +264,25 @@ class RouterConfig:
             raise ValueError(
                 "router.hedge_after_s must be >= 0 seconds (0 disables "
                 "hedging)"
+            )
+        if self.trace_ring < 0:
+            raise ValueError(
+                "router.trace_ring must be >= 0 traces (0 disables "
+                "stitched tracing)"
+            )
+        if self.access_log_sample < 1:
+            raise ValueError(
+                "router.access_log_sample must be >= 1 (1 = every "
+                "request)"
+            )
+        if self.access_log_max_mb <= 0:
+            raise ValueError(
+                "router.access_log_max_mb must be > 0 MB"
+            )
+        if not 0.0 <= self.slo_target < 1.0:
+            raise ValueError(
+                f"router.slo_target={self.slo_target} must be in "
+                f"[0, 1) — 1.0 leaves no error budget to burn"
             )
 
     @classmethod
@@ -424,10 +465,35 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             else:
                 self._json(200, telemetry.summary())
+        elif self.path == "/debug/trace" \
+                or self.path.startswith("/debug/trace/"):
+            ring = rt.obs.ring
+            trace_id = self.path[len("/debug/trace"):].strip("/")
+            if ring is None:
+                self._json(404, {"error": "stitched tracing disabled "
+                                          "(router.trace_ring = 0)"})
+            elif not trace_id:
+                self._json(200, {"traces": ring.ids()})
+            else:
+                record = ring.get(trace_id)
+                if record is None:
+                    self._json(404, {
+                        "error": f"no stitched trace '{trace_id}' in the "
+                                 f"ring (capacity {ring.capacity}; it "
+                                 f"may have been evicted)"
+                    })
+                else:
+                    self._json(200, record)
+        elif self.path == "/debug/slo":
+            tel = telemetry.current()
+            slo = tel.slo if tel is not None else None
+            self._json(200, slo.snapshot() if slo is not None
+                       else {"series": []})
         else:
             self._json(404, {"error": f"no route '{self.path}' (have "
                                       f"/generate, /admin/rollout [POST], "
-                                      f"/healthz, /readyz, /metrics)"})
+                                      f"/healthz, /readyz, /metrics, "
+                                      f"/debug/trace[/<id>], /debug/slo)"})
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
         rt = self.router
@@ -485,6 +551,15 @@ class FleetRouter:
         )
         #: rolling request latencies; p95 sets the hedge delay
         self._latency = LatencyWindow()  # guarded-by: _lock
+        #: stitched per-request fleet traces: bounded ring behind
+        #: GET /debug/trace/<id> + the sampled access.jsonl (router.obs)
+        self.obs = RouterObs(
+            trace_ring=config.trace_ring,
+            access_log=config.access_log,
+            access_log_sample=config.access_log_sample,
+            access_log_max_bytes=int(config.access_log_max_mb
+                                     * 1024 * 1024),
+        )
         #: one rollout at a time; held for the whole walk
         self._rollout_lock = threading.Lock()
         self._stop = threading.Event()
@@ -720,6 +795,11 @@ class FleetRouter:
         it reaches the client."""
         telemetry.inc("router/requests")
         started = monotonic()
+        trace_id = trace_id or new_trace_id()
+        # the stitched fleet trace for this request (router.obs): None
+        # when tracing is disabled or telemetry is off, and every
+        # recording site below is None-guarded
+        ftrace = self.obs.begin(trace_id)
         try:
             # fired ONCE per request, before any replica is picked, so an
             # injected exc is the router's own 500 path — failover below
@@ -727,8 +807,9 @@ class FleetRouter:
             chaos.maybe_inject("router_route")
         except chaos.ChaosError as e:
             telemetry.inc("router/request_errors")
+            self.obs.finish(ftrace, 500,
+                            error=f"{type(e).__name__}: {e}")
             return 500, {"error": f"{type(e).__name__}: {e}"}, {}
-        trace_id = trace_id or new_trace_id()
         key = self._affinity_key(body)
         # the replica's trace payload is the affinity feedback signal, so
         # the router always requests it and strips it back off below when
@@ -741,14 +822,16 @@ class FleetRouter:
         while True:
             try:
                 status, payload, backend, depth, how = self._attempt_hedged(
-                    key, tried, fwd_body, trace_id, hops
+                    key, tried, fwd_body, trace_id, hops, ftrace=ftrace
                 )
                 break
             except NoBackendAvailable as e:
                 telemetry.inc("router/request_errors")
+                self.obs.finish(ftrace, 503, error=str(e))
                 return 503, {"error": str(e)}, {}
             except _UpstreamRetryable as e:
                 failovers += 1
+                last = tried[-1] if tried else None
                 if failovers > self.config.failover_retries:
                     # out of hops: surface the LAST upstream answer (429
                     # keeps its pacing semantics; connection errors
@@ -759,14 +842,26 @@ class FleetRouter:
                         out_headers["Retry-After"] = str(
                             int(e.retry_after_s)
                         )
+                    self.obs.finish(
+                        ftrace, e.status or 503, error=str(e),
+                        backend=last.url if last else None,
+                    )
+                    self._slo_note(False, last)
                     return e.status or 503, e.payload, out_headers
-                if not self._spend_retry_token():
+                if not self._spend_retry_token(ftrace=ftrace,
+                                               reason="failover"):
                     # the structural bound on retry storms: refusing
                     # beats amplifying, and the typed payload tells the
                     # client this was the ROUTER's guardrail, not a
                     # replica verdict
                     telemetry.inc("router/retry_budget_exhausted")
                     telemetry.inc("router/request_errors")
+                    self.obs.finish(
+                        ftrace, 503,
+                        error=f"retry budget exhausted; last: {e}",
+                        backend=last.url if last else None,
+                    )
+                    self._slo_note(False, last)
                     return 503, {
                         "error": (
                             f"router retry budget exhausted "
@@ -780,6 +875,10 @@ class FleetRouter:
                 delay = e.retry_after_s \
                     if e.retry_after_s is not None \
                     else self.config.failover_backoff
+                if ftrace is not None:
+                    ftrace.event("failover", n=failovers,
+                                 delay_s=round(float(delay or 0.0), 4),
+                                 error=str(e))
                 print(f"[trlx_tpu.router] failover "
                       f"{failovers}/{self.config.failover_retries} in "
                       f"{delay:.2g}s ({e})", flush=True)
@@ -790,13 +889,33 @@ class FleetRouter:
                           elapsed=monotonic() - started)
         telemetry.inc("router/responses")
         telemetry.observe("router/forward_time", monotonic() - started)
+        self.obs.finish(
+            ftrace, status, backend=backend.url,
+            replica_trace=payload.get("trace")
+            if isinstance(payload, dict) else None,
+            slo_ttft_ms=self.config.slo_ttft_ms,
+        )
         out_headers = {"X-Request-Id": payload.get("trace_id", trace_id)}
         if not client_wants_trace:
             payload.pop("trace", None)
         return status, payload, out_headers
 
+    def _slo_note(self, ok: bool, backend: Optional[Backend]) -> None:
+        """Feed the windowed per-backend SLO series (serve.trace
+        SloEngine on the telemetry session) for a request that FAILED at
+        the router. Successes are scored in _note_routed where the
+        replica's TTFT is at hand; no-backend failures (empty fleet)
+        have no series to attribute and are skipped."""
+        if backend is None:
+            return
+        eng = slo_engine()
+        if eng is not None:
+            eng.record(ok, labels={"backend": backend.url})
+
     def _attempt_backend(self, backend: Backend, fwd_body: dict,
-                         trace_id: str, hops: int) -> Tuple[int, dict]:
+                         trace_id: str, hops: int,
+                         ftrace: Optional[FleetTrace] = None
+                         ) -> Tuple[int, dict]:
         """One request against one replica, with the full failure
         taxonomy applied: transport errors AND torn/malformed bodies
         (json/http.client failures — truncated garbage must fail over,
@@ -807,6 +926,8 @@ class FleetRouter:
         mid-decode under a kill answers 500 before the socket goes)
         must fail over, never surface. Success records a breaker
         success. Returns (status, payload)."""
+        if ftrace is not None:
+            ftrace.event("attempt", backend=backend.url)
         try:
             status, headers, payload = self._post_json(
                 backend.url + "/generate", fwd_body,
@@ -817,14 +938,20 @@ class FleetRouter:
                 },
             )
         except (OSError, ValueError, http.client.HTTPException) as e:
-            self._record_outcome(backend, ok=False)
+            if ftrace is not None:
+                ftrace.event("attempt_fail", backend=backend.url,
+                             error=f"{type(e).__name__}: {e}")
+            self._record_outcome(backend, ok=False, ftrace=ftrace)
             raise _UpstreamRetryable(
                 f"{backend.url} unreachable or torn response "
                 f"({type(e).__name__}: {e})"
             ) from e
         if status in (429, 500, 502, 503):
+            if ftrace is not None:
+                ftrace.event("attempt_fail", backend=backend.url,
+                             status=status)
             if status != 429:
-                self._record_outcome(backend, ok=False)
+                self._record_outcome(backend, ok=False, ftrace=ftrace)
             retry_after = headers.get("Retry-After")
             raise _UpstreamRetryable(
                 f"{backend.url} answered {status}: "
@@ -841,7 +968,10 @@ class FleetRouter:
             # parsed as JSON but is not a /generate response: the
             # backend (or something between) corrupted the body —
             # request failure, fail over, never forward garbage
-            self._record_outcome(backend, ok=False)
+            if ftrace is not None:
+                ftrace.event("attempt_fail", backend=backend.url,
+                             status=200, error="malformed /generate body")
+            self._record_outcome(backend, ok=False, ftrace=ftrace)
             telemetry.inc("router/response_invalid")
             shape = sorted(payload) if isinstance(payload, dict) \
                 else type(payload).__name__
@@ -850,11 +980,14 @@ class FleetRouter:
                 f"body (got {shape}, expected a JSON object with a "
                 f"'tokens' list)"
             )
-        self._record_outcome(backend, ok=True)
+        if ftrace is not None:
+            ftrace.event("attempt_ok", backend=backend.url, status=status)
+        self._record_outcome(backend, ok=True, ftrace=ftrace)
         return status, payload
 
     def _attempt_hedged(self, key, tried: List[Backend], fwd_body: dict,
-                        trace_id: str, hops: int
+                        trace_id: str, hops: int,
+                        ftrace: Optional[FleetTrace] = None
                         ) -> Tuple[int, dict, Backend, int, str]:
         """One failover-loop iteration: pick a replica and attempt it,
         optionally racing a hedged backup ("tail at scale"). With
@@ -871,10 +1004,13 @@ class FleetRouter:
                 f"{len(tried)} already tried this request)"
             )
         tried.append(backend)
+        if ftrace is not None:
+            ftrace.event("pick", backend=backend.url, how=how,
+                         depth=depth)
         delay = self._hedge_delay()
         if delay <= 0:
             status, payload = self._attempt_backend(
-                backend, fwd_body, trace_id, hops
+                backend, fwd_body, trace_id, hops, ftrace=ftrace
             )
             return status, payload, backend, depth, how
 
@@ -884,7 +1020,7 @@ class FleetRouter:
             try:
                 results.put(
                     (None,) + self._attempt_backend(
-                        b, fwd_body, trace_id, hops
+                        b, fwd_body, trace_id, hops, ftrace=ftrace
                     ) + (b, d, h)
                 )
             except Exception as e:  # delivered, not raised: the waiter
@@ -903,16 +1039,29 @@ class FleetRouter:
             if err is None:
                 return status, payload, b, d, h
             errors.append(err)
+        hedge_b: Optional[Backend] = None
         if in_flight:
             # primary outlived the tail cutoff: fire the backup
             hedge_b, hedge_depth, _ = self._pick(key, exclude=tried)
-            if hedge_b is None or not self._spend_retry_token():
+            if hedge_b is None or not self._spend_retry_token(
+                    ftrace=ftrace, reason="hedge"):
                 telemetry.inc("router/hedges_suppressed")
+                if ftrace is not None:
+                    ftrace.event(
+                        "hedge_suppressed",
+                        reason="no sibling replica" if hedge_b is None
+                        else "retry budget empty",
+                    )
+                hedge_b = None
             else:
                 try:
                     chaos.maybe_inject("router_hedge")
                     tried.append(hedge_b)
                     telemetry.inc("router/hedges")
+                    if ftrace is not None:
+                        ftrace.event("hedge_fire", backend=hedge_b.url,
+                                     depth=hedge_depth,
+                                     after_s=round(delay, 4))
                     threading.Thread(
                         target=attempt_into,
                         args=(hedge_b, hedge_depth, "hedge"),
@@ -921,6 +1070,10 @@ class FleetRouter:
                     in_flight += 1
                 except chaos.ChaosError as e:
                     telemetry.inc("router/hedges_suppressed")
+                    if ftrace is not None:
+                        ftrace.event("hedge_suppressed",
+                                     reason=f"{type(e).__name__}: {e}")
+                    hedge_b = None
                     print(f"[trlx_tpu.router] hedge suppressed: {e}",
                           flush=True)
         deadline = monotonic() + self.config.request_timeout + 5.0
@@ -933,6 +1086,13 @@ class FleetRouter:
             if err is None:
                 if h == "hedge":
                     telemetry.inc("router/hedge_wins")
+                    if ftrace is not None:
+                        ftrace.event("hedge_win", backend=b.url)
+                        ftrace.event("hedge_lose", backend=backend.url)
+                elif ftrace is not None and hedge_b is not None:
+                    # the primary answered first with a hedge in flight:
+                    # the backup is the discarded loser
+                    ftrace.event("hedge_lose", backend=hedge_b.url)
                 return status, payload, b, d, h
             errors.append(err)
         for err in errors:
@@ -965,7 +1125,8 @@ class FleetRouter:
         with self._lock:
             return max(self._latency.p95(), floor)
 
-    def _spend_retry_token(self) -> bool:
+    def _spend_retry_token(self, ftrace: Optional[FleetTrace] = None,
+                           reason: str = "failover") -> bool:
         """Debit the fleet-wide retry budget for one failover or hedge;
         False = bucket empty, the caller must not retry."""
         with self._lock:
@@ -978,21 +1139,31 @@ class FleetRouter:
                 )
         if ok:
             telemetry.inc("router/retry_budget_spent")
+            if ftrace is not None:
+                ftrace.event("retry_budget_spend", reason=reason)
         return ok
 
-    def _record_outcome(self, backend: Backend, ok: bool) -> None:
+    def _record_outcome(self, backend: Backend, ok: bool,
+                        ftrace: Optional[FleetTrace] = None) -> None:
         """Feed one request outcome to the backend's breaker (under the
         membership lock) and mirror the open-breaker count gauge."""
         with self._lock:
             if ok:
                 if backend.breaker.record_success():
                     telemetry.inc("router/breaker_closes")
+                    if ftrace is not None:
+                        ftrace.event("breaker_close", backend=backend.url)
                     print(f"[trlx_tpu.router] breaker CLOSED for "
                           f"{backend.url} (trial request succeeded)",
                           flush=True)
             else:
+                if ftrace is not None:
+                    ftrace.event("breaker_strike", backend=backend.url,
+                                 failures=backend.breaker.failures + 1)
                 if backend.breaker.record_failure(monotonic()):
                     telemetry.inc("router/breaker_opens")
+                    if ftrace is not None:
+                        ftrace.event("breaker_open", backend=backend.url)
                     print(f"[trlx_tpu.router] breaker OPEN for "
                           f"{backend.url} after "
                           f"{backend.breaker.failures} consecutive "
@@ -1046,12 +1217,18 @@ class FleetRouter:
                 self._slo_total += 1
                 slo = self.config.slo_ttft_ms
                 ttft_ms = (trace or {}).get("ttft_ms")
-                if slo <= 0 or ttft_ms is None or ttft_ms <= slo:
+                met_slo = slo <= 0 or ttft_ms is None or ttft_ms <= slo
+                if met_slo:
                     self._slo_good += 1
                 telemetry.set_gauge(
                     "router/fleet_goodput",
                     self._slo_good / max(self._slo_total, 1),
                 )
+                # the windowed per-backend twin of the lifetime gauge
+                # (serve.trace.SloEngine -> slo/goodput_5m{backend=...})
+                eng = slo_engine()
+                if eng is not None:
+                    eng.record(met_slo, labels={"backend": backend.url})
 
     # -- rolling checkpoint upgrades -------------------------------------- #
 
@@ -1192,6 +1369,8 @@ class FleetRouter:
             telemetry.set_gauge(
                 "router/retry_budget_tokens", self.config.retry_budget
             )
+        # pin the windowed-SLO objective (no-op when telemetry is off)
+        slo_engine(target=self.config.slo_target)
         # one synchronous sweep so start() returns with membership known
         # (a request racing the first probe would 503 spuriously)
         self.probe_fleet()

@@ -68,6 +68,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hedging floor in seconds: 0 disables; > 0 "
                         "fires a backup request on a second replica "
                         "after max(floor, rolling p95)")
+    p.add_argument("--trace-ring", type=int, default=None,
+                   help="stitched-trace ring capacity behind "
+                        "GET /debug/trace/<id> (0 = off)")
+    p.add_argument("--access-log", default=None,
+                   help="path for the sampled access.jsonl of stitched "
+                        "fleet traces (empty = off); read it back with "
+                        "python -m trlx_tpu.obs")
+    p.add_argument("--access-log-sample", type=int, default=None,
+                   help="write every Nth request to the access log "
+                        "(tail captures — SLO breach, error, hedge, "
+                        "failover — always land)")
+    p.add_argument("--access-log-max-mb", type=float, default=None,
+                   help="rotate access.jsonl to .1 past this size")
+    p.add_argument("--slo-target", type=float, default=None,
+                   help="goodput objective for the slo/burn_rate_* "
+                        "gauges, e.g. 0.99")
     return p
 
 
@@ -88,7 +104,9 @@ def router_config_from_args(args) -> RouterConfig:
                  "slo_ttft_ms", "stall_timeout",
                  "probe_failures_threshold", "breaker_threshold",
                  "breaker_cooldown", "retry_budget",
-                 "retry_budget_refill", "hedge_after_s"):
+                 "retry_budget_refill", "hedge_after_s",
+                 "trace_ring", "access_log", "access_log_sample",
+                 "access_log_max_mb", "slo_target"):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, flag, value)

@@ -362,8 +362,8 @@ class MicroBatcher:
             req.result = self.engine.depad_row(out, i, req.max_new_tokens)
             gen_total += len(req.result)
             req.latency_s = done_at - req.enqueued_at
-            # kept for dashboard continuity; superseded by the per-path
-            # serve/request_latency_static histogram complete() observes
+            # kept for dashboard continuity; superseded by the
+            # path-labeled serve/request_latency complete() observes
             telemetry.observe("serve/request_latency", req.latency_s)
             if req.trace is not None:
                 req.trace.note_static_decode(

@@ -113,6 +113,11 @@ class ServeConfig:
     :param slo_ttft_ms: the TTFT service-level objective in ms —
         ``serve/goodput`` is the fraction of completed requests whose
         time-to-first-token beat it. 0 counts every request as good.
+    :param slo_target: the goodput OBJECTIVE (fraction of requests
+        that must meet ``slo_ttft_ms``) the windowed SLO engine scores
+        burn rates against: ``slo/burn_rate_fast`` = (1 - goodput_5m)
+        / (1 - slo_target), so 1.0 burns the error budget exactly at
+        the sustainable rate (docs "Observability", runbook).
     :param flight_recorder_steps: ring size of the slot scheduler's
         per-step flight recorder (step index, lane counts, occupancy,
         pages_free, admissions/evictions, step walltime); dumped on
@@ -199,6 +204,7 @@ class ServeConfig:
     pages: int = 0
     request_tracing: bool = True
     slo_ttft_ms: float = 500.0
+    slo_target: float = 0.99
     flight_recorder_steps: int = 256
     max_replays: int = 2
     drain_timeout: float = 30.0
@@ -339,6 +345,11 @@ class InferenceEngine:
             raise ValueError(
                 f"serve.slo_ttft_ms={self.serve.slo_ttft_ms} must be >= 0 "
                 f"(0 = every completed request counts toward goodput)"
+            )
+        if not 0.0 <= self.serve.slo_target < 1.0:
+            raise ValueError(
+                f"serve.slo_target={self.serve.slo_target} must be in "
+                f"[0, 1) — 1.0 leaves no error budget to burn"
             )
         if self.serve.flight_recorder_steps < 0:
             raise ValueError(

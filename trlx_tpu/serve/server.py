@@ -225,10 +225,19 @@ class _Handler(BaseHTTPRequestHandler):
                     "slots": {},
                     "flight_recorder": [],
                 })
+        elif self.path == "/debug/slo":
+            # live windowed goodput/burn-rate per label set (serve.trace
+            # SloEngine); an empty body when telemetry is off or nothing
+            # has been scored yet — never a 404, dashboards poll this
+            tel = telemetry.current()
+            slo = tel.slo if tel is not None else None
+            self._json(200, slo.snapshot() if slo is not None
+                       else {"series": []})
         else:
             self._error(404, f"no route '{self.path}' (have /generate, "
                              f"/admin/drain, /admin/reload [POST], "
-                             f"/healthz, /readyz, /metrics, /debug/state)")
+                             f"/healthz, /readyz, /metrics, /debug/state, "
+                             f"/debug/slo)")
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
         srv = self.server_ref
@@ -582,6 +591,12 @@ class InferenceServer:
         if self.engine.serve.request_tracing:
             telemetry.predeclare(SLO_COUNTERS)
             telemetry.set_gauge("serve/goodput", 0.0)
+            # pin the windowed-SLO objective for this serve process so
+            # burn rates are scored against the configured target from
+            # the first request (no-op when telemetry is off)
+            from trlx_tpu.serve.trace import slo_engine
+
+            slo_engine(target=self.engine.serve.slo_target)
         if self.engine.serve.scheduler == "slots":
             telemetry.set_gauge("serve/slot_occupancy", 0.0)
             # quantization tier, visible per scrape: bytes one committed

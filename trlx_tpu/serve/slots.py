@@ -925,7 +925,7 @@ class SlotScheduler:
                 req.result = live.tokens
                 req.latency_s = done_at - req.enqueued_at
                 # kept for dashboard continuity; superseded by the
-                # per-path serve/request_latency_slots histogram
+                # path-labeled serve/request_latency complete() observes
                 telemetry.observe("serve/request_latency", req.latency_s)
                 if req.trace is not None:
                     req.trace.harvested = done_at

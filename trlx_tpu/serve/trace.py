@@ -16,11 +16,22 @@ is the host-side-only fix; nothing here crosses into a jitted program:
   per-step token times aggregated to ITL count/total/min/max (never
   stored raw), harvested, responded. :meth:`complete` derives the SLO
   family — ``serve/ttft``, ``serve/itl``, ``serve/queue_time``,
-  ``serve/prefill_time``, ``serve/decode_time``, per-scheduler
-  ``serve/request_latency_<path>`` histograms and the ``serve/goodput``
+  ``serve/prefill_time``, ``serve/decode_time``, the
+  ``serve/request_latency`` histogram labeled per scheduler path
+  (``{path="slots"|"static"}``) and the ``serve/goodput``
   gauge (fraction of requests with TTFT under ``serve.slo_ttft_ms``) —
   and exports the request as its own Perfetto track (one ``tid`` per
   request, child spans per phase) through the session's SpanTracer.
+- :class:`SloEngine` / :class:`SloWindow` — LIVE windowed goodput. The
+  lifetime ``serve/goodput`` gauge converges and stops moving on a long
+  run; the engine keeps a time-bucketed sliding window per label set
+  (path on the engine, backend on the router) and re-derives, on every
+  scored request, two-window goodput and error-budget burn rates
+  (``slo/goodput_5m``, ``slo/goodput_1h``, ``slo/burn_rate_fast``,
+  ``slo/burn_rate_slow`` — multi-window burn-rate alerting à la the SRE
+  workbook). It hangs off the TelemetrySession (``tel.slo``), so
+  ``telemetry: false`` keeps recording nothing; ``/debug/slo`` on the
+  engine and the router serves :meth:`SloEngine.snapshot`.
 - :class:`FlightRecorder` — a fixed-size ring
   (``serve.flight_recorder_steps``) the slot scheduler appends one
   compact record to per engine step: step index, active/finished lane
@@ -39,9 +50,10 @@ so trace arithmetic can never mix clock sources.
 import itertools
 import json
 import sys
+import threading
 import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from trlx_tpu import telemetry
 from trlx_tpu.supervisor import monotonic
@@ -49,6 +61,136 @@ from trlx_tpu.supervisor import monotonic
 #: the SLO histogram family complete() observes (docs "Observability");
 #: the server predeclares the counters so scrapes see zeros, not gaps
 SLO_COUNTERS = ("serve/slo_good", "serve/slo_total", "serve/flight_dumps")
+
+
+class SloWindow:
+    """Sliding two-window good/total accounting for ONE series.
+
+    Time is coarsened into fixed buckets (``slow_s / buckets`` wide);
+    each bucket holds (good, total) tallies and buckets older than the
+    slow window are expired on write — memory is O(buckets) no matter
+    how long the run. ``counts(window_s, now)`` sums the buckets inside
+    the trailing window (bucket-granular, which is exactly the
+    resolution an alerting burn rate needs)."""
+
+    __slots__ = ("fast_s", "slow_s", "bucket_s", "_buckets")
+
+    def __init__(self, fast_s: float = 300.0, slow_s: float = 3600.0,
+                 buckets: int = 120):
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.bucket_s = max(self.slow_s / max(int(buckets), 1), 1e-9)
+        self._buckets: deque = deque()  # [bucket_idx, good, total]
+
+    def record(self, ok: bool, now: float) -> None:
+        idx = int(now / self.bucket_s)
+        if not self._buckets or self._buckets[-1][0] != idx:
+            self._buckets.append([idx, 0, 0])
+        bucket = self._buckets[-1]
+        if ok:
+            bucket[1] += 1
+        bucket[2] += 1
+        floor = idx - int(self.slow_s / self.bucket_s) - 1
+        while self._buckets and self._buckets[0][0] < floor:
+            self._buckets.popleft()
+
+    def counts(self, window_s: float, now: float) -> Tuple[int, int]:
+        floor = int((now - window_s) / self.bucket_s)
+        good = total = 0
+        for idx, g, t in self._buckets:
+            if idx > floor:
+                good += g
+                total += t
+        return good, total
+
+
+class SloEngine:
+    """Per-label-set sliding SLO accounting + burn-rate gauges.
+
+    ``record(ok, now, labels=...)`` folds one scored request into that
+    label set's :class:`SloWindow` and refreshes the four windowed
+    gauges WITH the labels (``slo/goodput_5m{path="slots"}``, …). The
+    gauge names are canonical even when the windows are configured
+    shorter (tests use sub-second windows); an empty window reads
+    goodput 1.0 / burn 0.0 — no data is not an outage. Burn rate is
+    (1 - goodput) / (1 - target): 1.0 means the error budget burns
+    exactly at the rate that exhausts it over the window; a paging
+    threshold is a multiple of that (docs "Observability", runbook)."""
+
+    def __init__(self, target: float = 0.99, fast_s: float = 300.0,
+                 slow_s: float = 3600.0):
+        self.target = float(target)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self._lock = threading.Lock()
+        self._series: Dict[tuple, SloWindow] = {}  # guarded-by: _lock
+
+    def burn_rate(self, goodput: float) -> float:
+        budget = 1.0 - self.target
+        return (1.0 - goodput) / budget if budget > 0 else 0.0
+
+    def record(self, ok: bool, now: Optional[float] = None,
+               labels: Optional[Dict[str, Any]] = None) -> None:
+        now = monotonic() if now is None else now
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            win = self._series.get(key)
+            if win is None:
+                win = self._series[key] = SloWindow(self.fast_s,
+                                                    self.slow_s)
+            win.record(bool(ok), now)
+            good_f, tot_f = win.counts(self.fast_s, now)
+            good_s, tot_s = win.counts(self.slow_s, now)
+        gp_fast = good_f / tot_f if tot_f else 1.0
+        gp_slow = good_s / tot_s if tot_s else 1.0
+        telemetry.set_gauge("slo/goodput_5m", gp_fast, labels=labels)
+        telemetry.set_gauge("slo/goodput_1h", gp_slow, labels=labels)
+        telemetry.set_gauge("slo/burn_rate_fast", self.burn_rate(gp_fast),
+                            labels=labels)
+        telemetry.set_gauge("slo/burn_rate_slow", self.burn_rate(gp_slow),
+                            labels=labels)
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``/debug/slo`` body: target, window lengths, and one
+        entry per label set with live counts/goodput/burn rates."""
+        now = monotonic() if now is None else now
+        series = []
+        with self._lock:
+            items = sorted(self._series.items())
+            for key, win in items:
+                good_f, tot_f = win.counts(self.fast_s, now)
+                good_s, tot_s = win.counts(self.slow_s, now)
+                gp_fast = good_f / tot_f if tot_f else 1.0
+                gp_slow = good_s / tot_s if tot_s else 1.0
+                series.append({
+                    "labels": dict(key),
+                    "good_fast": good_f, "total_fast": tot_f,
+                    "good_slow": good_s, "total_slow": tot_s,
+                    "goodput_fast": round(gp_fast, 6),
+                    "goodput_slow": round(gp_slow, 6),
+                    "burn_rate_fast": round(self.burn_rate(gp_fast), 6),
+                    "burn_rate_slow": round(self.burn_rate(gp_slow), 6),
+                })
+        return {
+            "target": self.target,
+            "fast_window_s": self.fast_s,
+            "slow_window_s": self.slow_s,
+            "series": series,
+        }
+
+
+def slo_engine(target: Optional[float] = None):
+    """The active session's :class:`SloEngine`, created on first use
+    (None without a session — the ``telemetry: false`` no-op gate).
+    Passing ``target`` re-pins the objective (server/router start)."""
+    tel = telemetry.current()
+    if tel is None:
+        return None
+    if tel.slo is None:
+        tel.slo = SloEngine()
+    if target is not None:
+        tel.slo.target = float(target)
+    return tel.slo
 
 #: Perfetto track ids: one per request, starting clear of tid 0 (the
 #: process-level span track the tracer already uses)
@@ -171,19 +313,21 @@ class RequestTrace:
                 "serve/decode_time", max(self.harvested - self.prefill_end,
                                          0.0)
             )
-        # lint: disable=metric-dynamic-name -- path is the scheduler kind, a closed 2-value enum (slots/static); both expansions are in the observability.rst catalog
         telemetry.observe(
-            f"serve/request_latency_{path}", self.harvested - self.enqueued
+            "serve/request_latency", self.harvested - self.enqueued,
+            labels={"path": path},
         )
         telemetry.inc("serve/slo_total")
         tel = telemetry.current()
         if tel is None:
             return
-        good = tel.registry.inc("serve/slo_good", 0.0)
-        if slo_ttft_s <= 0 or self.ttft() <= slo_ttft_s:
-            good = tel.registry.inc("serve/slo_good")
+        ok = slo_ttft_s <= 0 or self.ttft() <= slo_ttft_s
+        good = tel.registry.inc("serve/slo_good", 1.0 if ok else 0.0)
         total = tel.registry.counters.get("serve/slo_total", 1.0)
         tel.registry.set_gauge("serve/goodput", good / max(total, 1.0))
+        slo_engine().record(
+            ok, now=self.harvested or None, labels={"path": path}
+        )
         self._export_spans(tel.tracer)
 
     def _export_spans(self, tracer) -> None:

@@ -97,6 +97,11 @@ class TelemetrySession:
         self.run_dir = run_dir
         self.force_dir = force_dir
         self.headline: Optional[Dict[str, Any]] = None
+        # lazily-attached windowed SLO engine (serve.trace.SloEngine —
+        # created by serve.trace.slo_engine() on first SLO-scored
+        # request). Living on the session keeps the telemetry:false
+        # contract: no session, no engine, no windows.
+        self.slo: Optional[Any] = None
         self.registry.predeclare(_PREDECLARED_COUNTERS)
 
     # -- per-iteration ---------------------------------------------------- #
@@ -210,9 +215,9 @@ def span(name: str):
     return _session.tracer.span(name)
 
 
-def inc(name: str, n: float = 1.0) -> None:
+def inc(name: str, n: float = 1.0, labels=None) -> None:
     if _session is not None:
-        _session.registry.inc(name, n)
+        _session.registry.inc(name, n, labels=labels)
 
 
 def predeclare(names) -> None:
@@ -227,14 +232,14 @@ def predeclare(names) -> None:
         _session.registry.predeclare(names)
 
 
-def set_gauge(name: str, value: float) -> None:
+def set_gauge(name: str, value: float, labels=None) -> None:
     if _session is not None:
-        _session.registry.set_gauge(name, value)
+        _session.registry.set_gauge(name, value, labels=labels)
 
 
-def observe(name: str, seconds: float) -> None:
+def observe(name: str, seconds: float, labels=None) -> None:
     if _session is not None:
-        _session.registry.observe(name, seconds)
+        _session.registry.observe(name, seconds, labels=labels)
 
 
 def summary() -> Dict[str, Any]:

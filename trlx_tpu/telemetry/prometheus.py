@@ -10,20 +10,38 @@ endpoint and a Prometheus server. Mapping:
   (predeclared-but-never-incremented counters render as 0, so a
   dashboard sees a zero series, not a missing one);
 - **gauges** -> ``<name>`` with ``# TYPE ... gauge``;
-- **timing histograms** -> Prometheus *summaries*: ``<name>_seconds``
-  quantile samples (p50/p95 over the registry's bounded window, the
-  same values the JSON summary reports), plus ``_sum`` / ``_count``.
-  An empty histogram renders sum/count 0 and quantiles 0.
+- **timing histograms** -> BOTH a Prometheus *summary*
+  (``<name>_seconds`` quantile samples over the registry's bounded
+  window, plus ``_sum`` / ``_count``) AND a real cumulative *histogram*
+  (``<name>_seconds_hist_bucket{le="..."}`` over the fixed log-spaced
+  :data:`~trlx_tpu.telemetry.registry.BUCKET_BOUNDS`, closing with
+  ``le="+Inf"`` == count). The summary keeps the existing dashboards;
+  the histogram family is what ``histogram_quantile()`` and cross-
+  replica aggregation need — summaries cannot be aggregated, buckets
+  can. The two live under distinct names because one metric name may
+  not carry two types.
+
+Registry keys carry optional labels in the flattened
+``name{k=v,...}`` form (see :func:`~trlx_tpu.telemetry.registry
+.label_key`); the renderer splits them back out and emits real
+Prometheus label sets, so ``serve/request_latency{path=slots}``
+scrapes as ``trlx_tpu_serve_request_latency_seconds{path="slots"}``.
+The ``# TYPE`` header is emitted once per family, not per series.
 
 Metric names pass through :func:`sanitize` — the registry's ``/``
 namespacing (``serve/ttft``) becomes ``_`` and everything gets the
-``trlx_tpu_`` prefix, so ``serve/ttft`` scrapes as
-``trlx_tpu_serve_ttft_seconds{quantile="0.5"}``.
+``trlx_tpu_`` prefix. Sanitization is lossy (``serve/ttft`` and
+``serve.ttft`` both map to ``trlx_tpu_serve_ttft``), so the renderer
+detects collisions between DISTINCT raw names and deterministically
+disambiguates every colliding name after the first (sorted raw order)
+with a ``_dupN`` suffix — duplicate series silently overwriting each
+other in the scraper is the failure mode this closes.
 """
 
 import re
+from typing import Dict, Iterable
 
-from trlx_tpu.telemetry.registry import MetricsRegistry
+from trlx_tpu.telemetry.registry import MetricsRegistry, split_label_key
 
 #: the exposition content type scrapers expect (text format 0.0.4)
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -39,29 +57,99 @@ def sanitize(name: str) -> str:
     return "trlx_tpu_" + out
 
 
+def sanitized_names(raw_names: Iterable[str]) -> Dict[str, str]:
+    """Collision-free raw->sanitized mapping: when two distinct raw
+    names sanitize identically, the first in sorted raw order keeps the
+    clean name and each later one gets a ``_dupN`` suffix (N = 2, 3, …
+    in sorted order — deterministic across renders)."""
+    out: Dict[str, str] = {}
+    taken: Dict[str, int] = {}
+    for raw in sorted(set(raw_names)):
+        clean = sanitize(raw)
+        seen = taken.get(clean, 0)
+        taken[clean] = seen + 1
+        out[raw] = clean if seen == 0 else f"{clean}_dup{seen + 1}"
+    return out
+
+
 def _fmt(value: float) -> str:
     return repr(float(value))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
+def _labelset(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _grouped(keys):
+    """sorted (base, labels, key) triples grouped so that all series of
+    one family are contiguous (base-sorted, then by flattened key)."""
+    triples = []
+    for key in keys:
+        base, labels = split_label_key(key)
+        triples.append((base, key, labels))
+    triples.sort(key=lambda t: (t[0], t[1]))
+    return triples
 
 
 def render(registry: MetricsRegistry) -> str:
     """The full registry in Prometheus text exposition format."""
     lines = []
-    for name in sorted(registry.counters):
-        metric = sanitize(name) + "_total"
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {_fmt(registry.counters[name])}")
-    for name in sorted(registry.gauges):
-        metric = sanitize(name)
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {_fmt(registry.gauges[name])}")
-    for name in sorted(registry.hists):
-        hist = registry.hists[name]
-        metric = sanitize(name) + "_seconds"
-        lines.append(f"# TYPE {metric} summary")
-        lines.append(f'{metric}{{quantile="0.5"}} {_fmt(hist.quantile(0.5))}')
+    with registry._lock:
+        counters = dict(registry.counters)
+        gauges = dict(registry.gauges)
+        hists = dict(registry.hists)
+
+    names = sanitized_names(
+        base for key in (*counters, *gauges, *hists)
+        for base in (split_label_key(key)[0],)
+    )
+
+    typed = set()
+
+    def _type(metric: str, kind: str) -> None:
+        if metric not in typed:
+            typed.add(metric)
+            lines.append(f"# TYPE {metric} {kind}")
+
+    for base, key, labels in _grouped(counters):
+        metric = names[base] + "_total"
+        _type(metric, "counter")
+        lines.append(f"{metric}{_labelset(labels)} {_fmt(counters[key])}")
+    for base, key, labels in _grouped(gauges):
+        metric = names[base]
+        _type(metric, "gauge")
+        lines.append(f"{metric}{_labelset(labels)} {_fmt(gauges[key])}")
+    for base, key, labels in _grouped(hists):
+        hist = hists[key]
+        metric = names[base] + "_seconds"
+        _type(metric, "summary")
+        q50 = _labelset(labels, extra='quantile="0.5"')
+        q95 = _labelset(labels, extra='quantile="0.95"')
+        lines.append(f"{metric}{q50} {_fmt(hist.quantile(0.5))}")
+        lines.append(f"{metric}{q95} {_fmt(hist.quantile(0.95))}")
+        lines.append(f"{metric}_sum{_labelset(labels)} {_fmt(hist.total)}")
         lines.append(
-            f'{metric}{{quantile="0.95"}} {_fmt(hist.quantile(0.95))}'
+            f"{metric}_count{_labelset(labels)} {_fmt(hist.count)}"
         )
-        lines.append(f"{metric}_sum {_fmt(hist.total)}")
-        lines.append(f"{metric}_count {_fmt(hist.count)}")
+        # the aggregatable cumulative-bucket family, distinct name
+        hmetric = metric + "_hist"
+        _type(hmetric, "histogram")
+        for bound, cum in hist.cumulative_buckets():
+            le = f'le="{_fmt(bound)}"'
+            lines.append(
+                f"{hmetric}_bucket{_labelset(labels, extra=le)} {cum}"
+            )
+        inf = _labelset(labels, extra='le="+Inf"')
+        lines.append(f"{hmetric}_bucket{inf} {hist.count}")
+        lines.append(f"{hmetric}_sum{_labelset(labels)} {_fmt(hist.total)}")
+        lines.append(f"{hmetric}_count{_labelset(labels)} {hist.count}")
     return "\n".join(lines) + "\n"

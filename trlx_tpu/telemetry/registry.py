@@ -25,17 +25,63 @@ hence RLock, a plain Lock would self-deadlock). Two export shapes:
 ``tracker_stats()`` is the flat float dict the existing tracker protocol
 carries per iteration; ``summary()`` is the structured run-level record
 ``telemetry.json`` persists.
+
+Every update accepts an optional ``labels`` dict (``{"path": "slots"}``,
+``{"backend": url}``): a labeled series is stored under the flattened
+key ``name{k=v,...}`` (keys sorted, so the same label set always lands
+on the same series) in the SAME counters/gauges/hists dicts — flat-dict
+consumers (trackers, ``/metrics`` JSON) see labeled series as ordinary
+keys, while the Prometheus renderer parses the key back into a base
+name plus a label set. Labels replace dynamic metric NAMES: a name is a
+closed vocabulary the docs and lint can audit; the varying dimension
+rides in the labels (graftlint's metric-name-literal rule enforces
+this at call sites).
 """
 
 import threading
 from collections import deque
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+#: fixed log-spaced latency bucket upper bounds (seconds) for the
+#: cumulative Prometheus ``_bucket`` rendering. Spanning 1 ms..300 s
+#: covers everything from a cached decode step to a compile-laden first
+#: rollout; anything beyond lands only in ``+Inf`` (== count).
+BUCKET_BOUNDS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def label_key(name: str, labels: Optional[Mapping[str, object]]) -> str:
+    """Flatten ``name`` + labels into the registry storage key:
+    ``name{k=v,...}`` with keys sorted (deterministic per label set)."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f"{k}={labels[k]}" for k in sorted(labels)
+    )
+    return f"{name}{{{inner}}}"
+
+
+def split_label_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`label_key`: ``name{k=v,...}`` -> (name, dict).
+    Plain keys come back with an empty label dict."""
+    if not key.endswith("}") or "{" not in key:
+        return key, {}
+    base, _, inner = key[:-1].partition("{")
+    labels: Dict[str, str] = {}
+    for part in inner.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return base, labels
 
 
 class TimingHist:
     """Duration accumulator for one named phase (seconds)."""
 
-    __slots__ = ("window", "count", "total", "max", "first", "last")
+    __slots__ = ("window", "count", "total", "max", "first", "last",
+                 "buckets")
 
     def __init__(self, window: int = 512):
         self.window = deque(maxlen=window)
@@ -44,6 +90,12 @@ class TimingHist:
         self.max = 0.0
         self.first: Optional[float] = None
         self.last = 0.0
+        # per-bound observation counts (NON-cumulative; the renderer
+        # accumulates into the Prometheus ``le`` convention). Unlike the
+        # quantile window these include every observation — a cumulative
+        # histogram with a silent hole at the first sample would make
+        # rate() lie.
+        self.buckets = [0] * len(BUCKET_BOUNDS)
 
     def observe(self, seconds: float) -> None:
         seconds = float(seconds)
@@ -58,6 +110,22 @@ class TimingHist:
         self.last = seconds
         if seconds > self.max:
             self.max = seconds
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            if seconds <= bound:
+                self.buckets[i] += 1
+                break
+        # over the last bound: counted only by +Inf (== self.count)
+
+    def cumulative_buckets(self) -> Tuple[Tuple[float, int], ...]:
+        """(upper_bound, cumulative_count) pairs, Prometheus ``le``
+        semantics; the ``+Inf`` bucket is ``self.count`` by definition
+        and is appended by the renderer."""
+        out = []
+        running = 0
+        for bound, n in zip(BUCKET_BOUNDS, self.buckets):
+            running += n
+            out.append((bound, running))
+        return tuple(out)
 
     def quantile(self, q: float) -> float:
         if not self.window:
@@ -96,21 +164,27 @@ class MetricsRegistry:
 
     # -- updates -------------------------------------------------------- #
 
-    def inc(self, name: str, n: float = 1.0) -> float:
+    def inc(self, name: str, n: float = 1.0,
+            labels: Optional[Mapping[str, object]] = None) -> float:
+        key = label_key(name, labels)
         with self._lock:
-            value = self.counters.get(name, 0.0) + n
-            self.counters[name] = value
+            value = self.counters.get(key, 0.0) + n
+            self.counters[key] = value
         return value
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Mapping[str, object]] = None) -> None:
+        key = label_key(name, labels)
         with self._lock:
-            self.gauges[name] = float(value)
+            self.gauges[key] = float(value)
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float,
+                labels: Optional[Mapping[str, object]] = None) -> None:
+        key = label_key(name, labels)
         with self._lock:
-            hist = self.hists.get(name)
+            hist = self.hists.get(key)
             if hist is None:
-                hist = self.hists[name] = TimingHist()
+                hist = self.hists[key] = TimingHist()
             hist.observe(seconds)
 
     def predeclare(self, names: Iterable[str]) -> None:

@@ -123,19 +123,33 @@ class SpanTracer:
             "args": {"name": label},
         })
 
-    def write_jsonl(self, path: str) -> str:
+    def write_jsonl(self, path: str,
+                    max_bytes: int = 64 * 1024 * 1024) -> str:
         """One Chrome-trace event per line. Perfetto loads the file as-is;
         a dropped-events marker is appended when the buffer overflowed so
-        a truncated trace never reads as a complete one."""
+        a truncated trace never reads as a complete one.
+
+        The file is size-bounded: when the serialized events exceed
+        ``max_bytes`` the OLDEST lines are dropped until the rest fit
+        (the recent tail is what a post-mortem reads), counted into the
+        same dropped-events marker — a long run's ``trace.jsonl`` never
+        grows past the budget."""
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        lines = [json.dumps(event) + "\n" for event in self.events]
+        dropped = self.dropped
+        total = sum(len(line) for line in lines)
+        at = 0
+        while at < len(lines) - 1 and total > max_bytes:
+            total -= len(lines[at])
+            at += 1
+            dropped += 1
         tmp = f"{path}.tmp-{os.getpid()}"
         with open(tmp, "w") as f:
-            for event in self.events:
-                f.write(json.dumps(event) + "\n")
-            if self.dropped:
+            f.writelines(lines[at:])
+            if dropped:
                 f.write(json.dumps({
-                    "name": f"[{self.dropped} events dropped]",
+                    "name": f"[{dropped} events dropped]",
                     "ph": "X",
                     "ts": round((self.clock() - self.t0) * 1e6, 3),
                     "dur": 0,
