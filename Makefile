@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos obs
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos obs overload overload-drill
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -15,7 +15,7 @@
 lint:
 	python -m trlx_tpu.analysis --budget 10
 
-check: lint kernels defense obs
+check: lint kernels defense obs overload
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| true
@@ -161,6 +161,26 @@ obs:
 # engine builds + warmups); opt-in via this target.
 fleet-chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_chaos.py \
+		-q -m slow
+
+# multi-tenant overload-containment tier (docs "Fault tolerance",
+# overload containment): the fast units — per-tenant token-bucket /
+# queue-share / inflight quota math, typed 429 QuotaExceeded with
+# tenant-derived Retry-After (never a global QueueFull for an
+# over-quota tenant), priority-aging starvation bound, brownout
+# hysteresis + best-effort max_new_tokens clamp, the /readyz pressure
+# block, the serve_quota chaos seam, and router-side pressure shedding
+# + per-tenant retry-budget slices over stub backends. Stub-backed and
+# CPU-cheap, so it gates `make check`; the live three-tenant isolation
+# drill (4x aggressor, premium goodput floor, zero recompiles, greedy
+# prefix-parity for browned-out completions) is the slow
+# `make overload-drill` tier.
+overload:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py \
+		-q -m 'not slow'
+
+overload-drill:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py \
 		-q -m slow
 
 serve-soak:

@@ -1102,6 +1102,17 @@ def bench_serving(n_requests=96, trace_seed=17):
     scales). Reports ``serve_slots_per_gb_int8`` — the acceptance bar
     is >= 1.8x the bf16 ``serve_slots_per_gb`` at this geometry.
 
+    Leg 7 — overload leg: three tenants on one engine — premium (with
+    quota headroom and priority), standard (best-effort), and an
+    aggressor bursting 4x its ``serve.tenants`` token bucket. Reports
+    ``serve_premium_goodput_under_overload`` (bar: >= 0.9),
+    ``serve_shed_typed_frac`` (fraction of sheds that were the typed
+    per-tenant 429 with Retry-After rather than a global QueueFull —
+    bar: 1.0), and ``serve_brownout_tokens_saved`` (decode tokens the
+    brownout clamp returned to the pool via degraded best-effort
+    answers). Zero lost accepted requests and zero recompiles are
+    asserted, not reported.
+
     Every leg also reports ``serve_decode_mfu`` (None off-TPU, where no
     bf16 peak is defined) and the request-lifecycle SLO metrics
     (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
@@ -1113,6 +1124,7 @@ def bench_serving(n_requests=96, trace_seed=17):
     from trlx_tpu import telemetry
     from trlx_tpu.data.configs import TRLConfig
     from trlx_tpu.serve import InferenceEngine, MicroBatcher, ServeConfig
+    from trlx_tpu.serve.batcher import QueueFull, QuotaExceeded
     from trlx_tpu.serve.slots import SlotScheduler
     from trlx_tpu.supervisor import chaos
 
@@ -1396,6 +1408,92 @@ def bench_serving(n_requests=96, trace_seed=17):
         f"{replay_saved} replay prefill tokens mapped through the "
         f"prefix cache, 0 lost")
 
+    # overload leg: three tenants on the SAME paged engine — premium
+    # (quota headroom + priority), standard (best-effort, shares the
+    # "default" policy), and an aggressor bursting 4x its token bucket.
+    # The first waves pile a backlog behind 16 slots so sustained
+    # starvation engages brownout; the aggressor then bursts into it.
+    # Every aggressor rejection must be the typed per-tenant 429
+    # (QuotaExceeded + its own Retry-After), never a global QueueFull.
+    engine.serve.tenants = {
+        "premium": {"max_queue_share": 0.9, "priority": 1},
+        "default": {"max_queue_share": 0.5},
+        "aggressor": {"rps": 4, "burst": 8, "max_queue_share": 0.5},
+    }
+    engine.serve.brownout_max_new = 4
+    engine.serve.brownout_after_s = 0.1
+    engine.serve.brownout_recover_s = 5.0
+    telemetry.start()
+    overload_sched = SlotScheduler(engine)
+    overload_sched.warmup()
+    overload_sched.start()
+    accepted, sheds, untyped_sheds = [], [], 0
+    try:
+        # wave 1: premium + standard fill the slots and build a backlog
+        for tokens, mn in trace[:32]:
+            accepted.append(("premium", mn, overload_sched.submit(
+                tokens, max_new_tokens=mn, tenant="premium")))
+        for tokens, mn in trace[32:56]:
+            accepted.append(("standard", mn, overload_sched.submit(
+                tokens, max_new_tokens=mn, tenant="standard")))
+        # brownout needs the pressure signal SUSTAINED for
+        # brownout_after_s — wait for the hysteresis to trip
+        t_wait = time.perf_counter()
+        while (not overload_sched.pressure()["brownout"]
+               and time.perf_counter() - t_wait < 30.0):
+            time.sleep(0.005)
+        browned = overload_sched.pressure()["brownout"]
+        # wave 2: late best-effort arrivals land clamped (degraded
+        # partial answers), and the aggressor bursts 32 requests
+        # against an 8-token bucket refilling at 4/s — ~4x quota
+        for tokens, mn in trace[88:96]:
+            accepted.append(("standard", mn, overload_sched.submit(
+                tokens, max_new_tokens=mn, tenant="standard")))
+        for tokens, mn in trace[56:88]:
+            try:
+                accepted.append(("aggressor", mn, overload_sched.submit(
+                    tokens, max_new_tokens=mn, tenant="aggressor")))
+            except QuotaExceeded as e:
+                sheds.append(e)
+            except QueueFull:
+                untyped_sheds += 1
+        for _, _, r in accepted:
+            r.wait(timeout=600.0)
+    finally:
+        overload_sched.stop()
+        engine.serve.tenants = None
+        engine.serve.brownout_max_new = 0
+    lost = sum(1 for _, _, r in accepted if r.result is None)
+    if lost:
+        raise RuntimeError(f"overload leg lost {lost} accepted requests")
+    overload_recompiles = int(
+        telemetry.current().registry.counters.get("compile/recompiles", 0.0)
+    )
+    if overload_recompiles:
+        raise RuntimeError(
+            f"overload leg recompiled {overload_recompiles}x — the "
+            f"brownout clamp must stay inside the compiled bucket lattice"
+        )
+    degraded_reqs = [(t, mn, r) for t, mn, r in accepted if r.degraded]
+    brownout_saved = sum(
+        mn - len(r.result) for _, mn, r in degraded_reqs
+    )
+    premium_reqs = [r for t, _, r in accepted if t == "premium"]
+    premium_goodput = sum(
+        1 for r in premium_reqs
+        if r.result is not None and r.error is None
+    ) / max(len(premium_reqs), 1)
+    typed_ok = sum(1 for e in sheds
+                   if e.tenant == "aggressor" and e.retry_after_s >= 1)
+    total_sheds = len(sheds) + untyped_sheds
+    shed_typed_frac = (typed_ok / total_sheds) if total_sheds else 1.0
+    log(f"serve[overload]:   premium goodput {premium_goodput:.2f} under "
+        f"a 4x-quota aggressor; {total_sheds} sheds "
+        f"({shed_typed_frac:.0%} typed per-tenant 429), brownout "
+        f"{'engaged' if browned else 'did not engage'} — "
+        f"{len(degraded_reqs)} degraded answers saved {brownout_saved} "
+        f"decode tokens, 0 accepted requests lost, 0 recompiles")
+
     def slo_keys(stats, suffix=""):
         return {
             f"serve_ttft_p50_ms{suffix}": round(stats["ttft_p50"], 1),
@@ -1556,6 +1654,24 @@ def bench_serving(n_requests=96, trace_seed=17):
         "serve_prefix_workload": (
             f"{n_requests}-request burst, 4 shared 48-token system "
             f"prompts + 2..8-token unique tails, paged page_size=16"
+        ),
+        # overload leg: per-tenant quotas + brownout under a 4x-quota
+        # aggressor (docs "Fault tolerance", overload containment)
+        "serve_premium_goodput_under_overload": round(premium_goodput, 3),
+        "serve_shed_typed_frac": round(shed_typed_frac, 3),
+        "serve_overload_sheds": total_sheds,
+        "serve_brownout_engaged": bool(browned),
+        "serve_brownout_degraded_requests": len(degraded_reqs),
+        "serve_brownout_tokens_saved": int(brownout_saved),
+        "serve_overload_workload": (
+            "three tenants on one paged engine: 32 premium (priority, "
+            "quota headroom) + 32 standard (best-effort, shares the "
+            "default policy) building a backlog behind 16 slots, then "
+            "a 32-request aggressor burst against an 8-token bucket "
+            "refilling at 4/s (~4x quota); sheds must be the typed "
+            "per-tenant 429, brownout clamps late best-effort arrivals "
+            "to 4 tokens; zero lost accepted requests and zero "
+            "recompiles are asserted"
         ),
         # sharded leg (absent on a single device)
         **tp_keys,

@@ -1,0 +1,20 @@
+"""Planted: typed HTTP errors on the serving surface with no row in
+the docs/source/serving.rst error-taxonomy table."""
+
+
+class FixtureQueueSaturated(RuntimeError):
+    """A typed 429 at the admission door — must be catalogued."""
+
+
+class FixtureShedding(FixtureQueueSaturated):
+    """IS-A member via the in-file fixpoint (like Draining(QueueFull))
+    — subclasses are wire contract too."""
+
+
+class _FixturePlumbing(RuntimeError):
+    """Underscore-private: internal control flow, never serialized to a
+    client — exempt."""
+
+
+class FixtureConfig:
+    """Not an exception at all — exempt."""

@@ -1,0 +1,19 @@
+"""Compliant spelling: the same typed HTTP errors, each with a
+serving.rst taxonomy row (class name + status code on one line) —
+the wiring test supplies the doc."""
+
+
+class FixtureQueueSaturated(RuntimeError):
+    """429 at the admission door; catalogued by the test's doc."""
+
+
+class FixtureShedding(FixtureQueueSaturated):
+    """Subclass member, also catalogued."""
+
+
+class _FixturePlumbing(RuntimeError):
+    """Underscore-private plumbing needs no row."""
+
+
+class FixtureConfig:
+    """Plain class, out of scope."""

@@ -176,6 +176,51 @@ def test_metric_catalog_sync_clean_when_catalogued():
     assert lint(files, select=None, docs=docs) == []
 
 
+SERVING_DOC = "docs/source/serving.rst"
+
+
+def test_error_taxonomy_fires_on_undocumented_error_class():
+    """Public exception classes under trlx_tpu/serve/ (the subclass via
+    the in-file fixpoint included) each need a serving.rst row; the
+    underscore-private and non-exception classes are exempt."""
+    files = {"trlx_tpu/serve/mod.py":
+             fixture("contracts/error_taxonomy_bad.py")}
+    findings = lint(files, select=["error-taxonomy-documented"])
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert flagged == {"FixtureQueueSaturated", "FixtureShedding"}
+
+
+def test_error_taxonomy_quiet_when_documented_with_status():
+    files = {"trlx_tpu/router/mod.py":
+             fixture("contracts/error_taxonomy_ok.py")}
+    docs = {SERVING_DOC: (
+        "``FixtureQueueSaturated``  429  admission door saturated\n"
+        "``FixtureShedding``        429  typed shed\n"
+    )}
+    assert lint(files, select=["error-taxonomy-documented"],
+                docs=docs) == []
+
+
+def test_error_taxonomy_requires_status_code_on_the_row():
+    """Prose that merely name-drops the class is not a taxonomy row —
+    the line must also carry the HTTP status code."""
+    files = {"trlx_tpu/serve/mod.py":
+             fixture("contracts/error_taxonomy_ok.py")}
+    docs = {SERVING_DOC: (
+        "FixtureQueueSaturated is raised when the queue saturates.\n"
+        "FixtureShedding marks a shed request.\n"
+    )}
+    findings = lint(files, select=["error-taxonomy-documented"],
+                    docs=docs)
+    assert len(findings) == 2
+
+
+def test_error_taxonomy_ignores_modules_outside_http_surface():
+    files = {"trlx_tpu/utils/mod.py":
+             fixture("contracts/error_taxonomy_bad.py")}
+    assert lint(files, select=["error-taxonomy-documented"]) == []
+
+
 def test_chaos_seam_registered_fires_on_unknown_seam():
     files = {
         REGISTRY: fixture("contracts/chaos_registry.py"),
@@ -278,7 +323,7 @@ def test_bad_suppression_cannot_suppress_itself():
 
 def test_rule_catalog_metadata_is_complete():
     run_rules(ProjectModel(files={}))  # force rule registration
-    assert len(RULES) >= 26
+    assert len(RULES) >= 27
     assert {r.family for r in RULES.values()} == {
         "style", "jax", "locks", "contracts", "concurrency",
     }

@@ -203,7 +203,7 @@ def test_deadline_flush_partial_batch(engine, fresh_registry, batcher):
     assert fresh_registry.gauges["serve/batch_fill_ratio"] == 0.5
     assert fresh_registry.counters["serve/batches"] == 1.0
     assert fresh_registry.counters["serve/responses"] == 1.0
-    assert "serve/request_latency" in fresh_registry.hists
+    assert "serve/request_latency{path=static}" in fresh_registry.hists
 
 
 def test_static_path_populates_request_trace(engine, fresh_registry,
@@ -463,8 +463,8 @@ def test_metrics_dump_has_serve_family(server):
     assert "serve/batch_fill_ratio" in gauges
     assert "serve/tokens_per_sec" in gauges
     assert any(k.startswith("time/serve/decode_") for k in body["timings"])
-    assert "serve/request_latency" in body["timings"]
-    hist = body["timings"]["serve/request_latency"]
+    assert "serve/request_latency{path=static}" in body["timings"]
+    hist = body["timings"]["serve/request_latency{path=static}"]
     assert "p50_s" in hist and "p95_s" in hist
 
 

@@ -10,6 +10,7 @@ test — a seam nobody injects is a fault path that has never run.
 """
 
 import ast
+import re
 from typing import Iterable, List, Optional, Tuple
 
 from trlx_tpu.analysis import Rule, register
@@ -346,6 +347,89 @@ class ChaosSeamTestedRule(Rule):
                 if isinstance(t, ast.Name) and t.id == "KNOWN_SEAMS":
                     return node.lineno, _const_strings(node.value)
         return None
+
+
+#: the serving doc whose error-taxonomy table every typed HTTP error
+#: must appear in (docs/source/serving.rst, "Error taxonomy")
+SERVING_DOC = "docs/source/serving.rst"
+
+#: a doc line counts as a taxonomy row only when it also names an HTTP
+#: 4xx/5xx status — prose that merely mentions the class doesn't
+_STATUS_RE = re.compile(r"\b[45]\d\d\b")
+
+
+@register
+class ErrorTaxonomyDocumentedRule(LibraryRule):
+    id = "error-taxonomy-documented"
+    family = "contracts"
+    rationale = (
+        "the serving HTTP surface maps typed exceptions to status codes "
+        "(429 quota/queue, 503 replay/deadline/fleet, 508 hop loop); "
+        "clients and the fleet router branch on those codes, so an "
+        "exception class added under trlx_tpu/serve/ or trlx_tpu/router/ "
+        "without a row in the serving.rst error table is a wire contract "
+        "nobody documented — operators cannot tell a shed from a fault, "
+        "and the next handler author guesses the status"
+    )
+    hint = (
+        "add the class to the error-taxonomy table in "
+        "docs/source/serving.rst: one row naming the class AND its HTTP "
+        "status code (e.g. 'QuotaExceeded ... 429')"
+    )
+
+    #: the HTTP-facing subsystems under the contract
+    _SCOPE = ("trlx_tpu/serve/", "trlx_tpu/router/")
+
+    def check(self, ctx, project):
+        if not ctx.path.startswith(self._SCOPE):
+            return
+        doc_rows = [
+            line for line in project.docs.get(SERVING_DOC, "").splitlines()
+            if _STATUS_RE.search(line)
+        ]
+        for name, line in self._exception_classes(ctx):
+            if name.startswith("_"):
+                continue  # internal plumbing, not a wire contract
+            if not any(name in row for row in doc_rows):
+                yield self.finding(
+                    ctx, line,
+                    f"typed HTTP error '{name}' has no row in the "
+                    f"serving.rst error-taxonomy table (class name + "
+                    f"status code on one line)",
+                )
+
+    @staticmethod
+    def _exception_classes(ctx: FileContext) -> List[Tuple[str, int]]:
+        """(name, line) of every class that IS-A exception: a base name
+        ending Error/Exception, or — to a fixpoint — a base that is
+        itself such a class in this file (Draining(QueueFull) and
+        QuotaExceeded(QueueFull) are taxonomy members too)."""
+        classes = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            classes[node.name] = (bases, node.lineno)
+        excs = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, (bases, _) in classes.items():
+                if name in excs:
+                    continue
+                if any(b.endswith(("Error", "Exception")) or b in excs
+                       for b in bases):
+                    excs.add(name)
+                    changed = True
+        return sorted(
+            ((name, classes[name][1]) for name in excs),
+            key=lambda pair: pair[1],
+        )
 
 
 @register

@@ -63,6 +63,22 @@ operable as one unit. Four pieces:
   answering 200 with a truncated or non-/generate-shaped JSON body is
   a request failure that fails over, never garbage forwarded to the
   client.
+- **Multi-tenant overload containment** (docs "Fault tolerance",
+  overload runbook). The router reads each request's tenant identity
+  (``X-Tenant-Id`` header or ``"tenant"`` body field, ``default`` when
+  absent) and stamps it onto the forwarded body so replica-side quotas
+  see the same principal. ``router.tenants`` carves the retry budget
+  into per-tenant token-bucket slices (``rps``/``burst``): a failover
+  or hedge debits the TENANT's slice before the fleet bucket, so one
+  aggressor's storm cannot drain retries for everyone (exhaustion is a
+  typed 503, ``router/tenant_budget_exhausted{tenant=...}``). The
+  prober also ingests each replica's published ``pressure`` block
+  (``/readyz``): while at least ``shed_pressure_threshold`` of the
+  admitting fleet reports pressure (degraded or brownout), best-effort
+  tenants (``priority <= 0``) are shed AT THE ROUTER — a cheap local
+  429 + Retry-After (``router/shed_pressure{tenant=...}``) that adds
+  zero load to saturated backends. Terminal 429/503 answers always
+  carry ``Retry-After`` (the upstream's own pacing when it gave one).
 - **Rolling checkpoint upgrades** (``POST /admin/rollout``). One replica
   at a time: fence it from routing (the engine's own ``/admin/drain`` is
   process-terminal by crash-only design, so the router drains at the
@@ -145,6 +161,11 @@ _ROUTER_COUNTERS = (
     "router/hedge_wins",
     "router/hedges_suppressed",
     "router/response_invalid",
+    # overload-containment family (docs "Fault tolerance"): sheds taken
+    # at the router's edge from published backend pressure, and spends
+    # refused by a PER-TENANT slice of the retry budget
+    "router/shed_pressure",
+    "router/tenant_budget_exhausted",
 )
 
 
@@ -234,6 +255,20 @@ class RouterConfig:
     #: goodput objective the windowed SLO engine scores burn rates
     #: against (slo/burn_rate_* gauges; docs "Observability", runbook)
     slo_target: float = 0.99
+    #: per-tenant retry-budget slices: ``{name: {rps, burst, priority}}``.
+    #: ``rps``/``burst`` bound THAT tenant's failover+hedge spend (its
+    #: own token bucket, debited before the fleet-wide budget, so one
+    #: aggressor cannot monopolize retries); ``priority <= 0`` marks the
+    #: tenant best-effort for pressure shedding. A ``default`` entry
+    #: governs requests with no tenant id and unknown tenants alike.
+    #: None disables both mechanisms (single-tenant behavior).
+    tenants: Optional[Dict[str, Any]] = None
+    #: shed best-effort tenants at the router's edge when at least this
+    #: fraction of admitting replicas publish pressure (degraded or in
+    #: brownout) on /readyz — a cheap local 429 + Retry-After instead of
+    #: forwarding into a saturated fleet (<= 0 disables; 1.0 = only when
+    #: EVERY admitting replica is pressured)
+    shed_pressure_threshold: float = 1.0
 
     def __post_init__(self):
         if not self.backends:
@@ -284,6 +319,24 @@ class RouterConfig:
                 f"router.slo_target={self.slo_target} must be in "
                 f"[0, 1) — 1.0 leaves no error budget to burn"
             )
+        if self.shed_pressure_threshold > 1.0:
+            raise ValueError(
+                f"router.shed_pressure_threshold="
+                f"{self.shed_pressure_threshold} is a fraction of "
+                f"admitting replicas — must be <= 1.0 (<= 0 disables)"
+            )
+        for name, spec in (self.tenants or {}).items():
+            if not isinstance(spec, dict):
+                raise ValueError(
+                    f"router.tenants['{name}'] must be a mapping, got "
+                    f"{type(spec).__name__}"
+                )
+            unknown = set(spec) - {"rps", "burst", "priority"}
+            if unknown:
+                raise ValueError(
+                    f"router.tenants['{name}']: unknown key(s) "
+                    f"{sorted(unknown)} (known: burst, priority, rps)"
+                )
 
     @classmethod
     def from_dict(cls, config: Optional[dict]) -> "RouterConfig":
@@ -397,6 +450,10 @@ class Backend:
         self.rolling = False      # fenced by an in-progress rollout step
         self.queue_depth = 0
         self.degraded = False
+        #: the replica's published backpressure block (/readyz
+        #: "pressure"), refreshed each prober sweep — what the router's
+        #: edge-shed decision reads
+        self.pressure: dict = {}
         self.model_version = 0
         self.requests = 0         # requests routed here (lifetime)
         self.probe_failures = 0   # consecutive
@@ -410,6 +467,7 @@ class Backend:
             "rolling": self.rolling,
             "queue_depth": self.queue_depth,
             "degraded": self.degraded,
+            "pressure": self.pressure,
             "model_version": self.model_version,
             "requests": self.requests,
             "breaker": self.breaker.state,
@@ -516,7 +574,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                       f"(have /generate, /admin/rollout)"})
             return
         status, payload, headers = rt.forward(
-            body, trace_id=request_id, hops=hops
+            body, trace_id=request_id, hops=hops,
+            tenant=self.headers.get("X-Tenant-Id") or None,
         )
         self._json(status, payload, headers=headers)
 
@@ -549,6 +608,18 @@ class FleetRouter:
         self._retry_budget = RetryBudget(  # guarded-by: _lock
             config.retry_budget, config.retry_budget_refill
         )
+        #: per-tenant slices of the retry budget (router.tenants): each
+        #: tenant's failovers/hedges debit ITS bucket before the fleet
+        #: one, so one aggressor's storm cannot drain retries for
+        #: everyone. Keyed by policy name — unknown tenants share the
+        #: "default" entry's bucket, exactly like the engine's quotas.
+        self._tenant_budgets: Dict[str, RetryBudget] = {  # guarded-by: _lock
+            name: RetryBudget(
+                float(spec.get("burst", 0) or 0),
+                float(spec.get("rps", 0) or 0),
+            )
+            for name, spec in (config.tenants or {}).items()
+        }
         #: rolling request latencies; p95 sets the hedge delay
         self._latency = LatencyWindow()  # guarded-by: _lock
         #: stitched per-request fleet traces: bounded ring behind
@@ -648,6 +719,9 @@ class FleetRouter:
                 b.probe_failures = 0
                 b.queue_depth = int(state.get("queue_depth", b.queue_depth))
                 b.degraded = bool(state.get("degraded", False))
+                pressure = state.get("pressure")
+                b.pressure = dict(pressure) \
+                    if isinstance(pressure, dict) else {}
                 if version:
                     b.model_version = version
                 if not b.admitted and not b.rolling:
@@ -696,6 +770,11 @@ class FleetRouter:
             telemetry.set_gauge(
                 "router/degraded_backends",
                 float(sum(1 for b in admitted if b.degraded)),
+            )
+            telemetry.set_gauge(
+                "router/pressured_backends",
+                float(sum(1 for b in admitted if b.pressure.get(
+                    "degraded") or b.pressure.get("brownout"))),
             )
             # min over admitted replicas: the gauge CONVERGES to the new
             # version exactly when the last replica finishes its rollout
@@ -779,23 +858,33 @@ class FleetRouter:
             return backend, depth, how
 
     def forward(self, body: dict, trace_id: Optional[str] = None,
-                hops: int = 0) -> Tuple[int, dict, dict]:
+                hops: int = 0, tenant: Optional[str] = None
+                ) -> Tuple[int, dict, dict]:
         """Route one ``/generate`` body: pick a replica, forward with
-        the trace id and hop count stamped through, fail over
-        idempotent-safe errors onto a second replica honoring its
+        the trace id, hop count, and tenant id stamped through, fail
+        over idempotent-safe errors onto a second replica honoring its
         ``Retry-After``. Returns (status, payload, response-headers) for
         the HTTP layer; also the direct entry point for in-process
         callers (tests, bench).
 
         Containment (module docstring): every failover spends a
-        retry-budget token — an empty bucket answers a typed 503
-        (``router/retry_budget_exhausted``) instead of multiplying
-        fleet load; each attempt is breaker-gated, hedged when
-        ``hedge_after_s`` > 0, and its response body validated before
-        it reaches the client."""
+        retry-budget token — first from the TENANT's slice when
+        ``router.tenants`` carves them, then from the fleet bucket; an
+        empty bucket answers a typed 503 (``router/retry_budget_\
+exhausted`` / ``router/tenant_budget_exhausted``) instead of
+        multiplying fleet load. Best-effort tenants are shed locally
+        (429 + Retry-After, ``router/shed_pressure``, nothing forwarded)
+        while enough of the fleet publishes pressure; each attempt is
+        breaker-gated, hedged when ``hedge_after_s`` > 0, and its
+        response body validated before it reaches the client. Terminal
+        429/503 answers always carry a ``Retry-After`` so every shed is
+        actionable client pacing, never a dead end."""
         telemetry.inc("router/requests")
         started = monotonic()
         trace_id = trace_id or new_trace_id()
+        if not tenant and body.get("tenant") is not None:
+            tenant = str(body["tenant"])
+        tenant = tenant or "default"
         # the stitched fleet trace for this request (router.obs): None
         # when tracing is disabled or telemetry is off, and every
         # recording site below is None-guarded
@@ -810,6 +899,27 @@ class FleetRouter:
             self.obs.finish(ftrace, 500,
                             error=f"{type(e).__name__}: {e}")
             return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        # end-to-end backpressure: while enough of the fleet publishes
+        # pressure, a best-effort tenant is answered HERE — a cheap 429
+        # with the replicas' own pacing — instead of adding load to
+        # saturated backends (docs "Fault tolerance", overload runbook)
+        shed_after = self._shed_for_pressure(tenant)
+        if shed_after is not None:
+            telemetry.inc("router/shed_pressure")
+            telemetry.inc("router/shed_pressure",
+                          labels={"tenant": tenant})
+            self.obs.finish(ftrace, 429,
+                            error="shed at the router under fleet "
+                                  "pressure")
+            return 429, {
+                "error": (
+                    f"fleet under pressure: best-effort tenant "
+                    f"'{tenant}' shed at the router "
+                    f"(retry after {shed_after}s)"
+                ),
+                "tenant": tenant,
+                "shed_pressure": True,
+            }, {"Retry-After": str(shed_after)}
         key = self._affinity_key(body)
         # the replica's trace payload is the affinity feedback signal, so
         # the router always requests it and strips it back off below when
@@ -817,6 +927,11 @@ class FleetRouter:
         client_wants_trace = bool(body.get("trace"))
         fwd_body = dict(body)
         fwd_body["trace"] = True
+        # tenant identity rides the forwarded body (the engine accepts
+        # the "tenant" field and the X-Tenant-Id header identically), so
+        # replica-side quotas see the same principal the router did
+        if "tenant" not in fwd_body:
+            fwd_body["tenant"] = tenant
         tried: List[Backend] = []
         failovers = 0
         while True:
@@ -828,7 +943,13 @@ class FleetRouter:
             except NoBackendAvailable as e:
                 telemetry.inc("router/request_errors")
                 self.obs.finish(ftrace, 503, error=str(e))
-                return 503, {"error": str(e)}, {}
+                # pace the client at the prober cadence: membership can
+                # change no faster than the next sweep
+                return 503, {"error": str(e)}, {
+                    "Retry-After": str(max(
+                        1, int(self.config.probe_interval)
+                    )),
+                }
             except _UpstreamRetryable as e:
                 failovers += 1
                 last = tried[-1] if tried else None
@@ -837,40 +958,64 @@ class FleetRouter:
                     # keeps its pacing semantics; connection errors
                     # become 503)
                     telemetry.inc("router/request_errors")
-                    out_headers = {}
-                    if e.retry_after_s is not None:
-                        out_headers["Retry-After"] = str(
-                            int(e.retry_after_s)
-                        )
+                    # propagate the upstream's pacing; a backend that
+                    # gave none still gets a floor — terminal 429/503
+                    # answers always tell the client WHEN to come back
+                    out_headers = {
+                        "Retry-After": str(max(
+                            1, int(e.retry_after_s or 1)
+                        )),
+                    }
                     self.obs.finish(
                         ftrace, e.status or 503, error=str(e),
                         backend=last.url if last else None,
                     )
                     self._slo_note(False, last)
                     return e.status or 503, e.payload, out_headers
-                if not self._spend_retry_token(ftrace=ftrace,
-                                               reason="failover"):
+                denied = self._spend_retry_token(
+                    ftrace=ftrace, reason="failover", tenant=tenant
+                )
+                if denied is not None:
                     # the structural bound on retry storms: refusing
                     # beats amplifying, and the typed payload tells the
-                    # client this was the ROUTER's guardrail, not a
-                    # replica verdict
-                    telemetry.inc("router/retry_budget_exhausted")
-                    telemetry.inc("router/request_errors")
-                    self.obs.finish(
-                        ftrace, 503,
-                        error=f"retry budget exhausted; last: {e}",
-                        backend=last.url if last else None,
-                    )
-                    self._slo_note(False, last)
-                    return 503, {
-                        "error": (
+                    # client WHICH guardrail refused — its own tenant's
+                    # slice or the fleet bucket — not a replica verdict
+                    if denied == "tenant":
+                        telemetry.inc("router/tenant_budget_exhausted")
+                        telemetry.inc("router/tenant_budget_exhausted",
+                                      labels={"tenant": tenant})
+                        error = (
+                            f"retry budget for tenant '{tenant}' "
+                            f"exhausted; last failure: {e}"
+                        )
+                        refill = self._tenant_refill(tenant)
+                    else:
+                        telemetry.inc("router/retry_budget_exhausted")
+                        error = (
                             f"router retry budget exhausted "
                             f"(capacity {self.config.retry_budget}, "
                             f"refill {self.config.retry_budget_refill}"
                             f"/s); last failure: {e}"
-                        ),
+                        )
+                        refill = self.config.retry_budget_refill
+                    telemetry.inc("router/request_errors")
+                    self.obs.finish(
+                        ftrace, 503,
+                        error=f"retry budget exhausted ({denied}); "
+                              f"last: {e}",
+                        backend=last.url if last else None,
+                    )
+                    self._slo_note(False, last)
+                    return 503, {
+                        "error": error,
                         "retry_budget_exhausted": True,
-                    }, {}
+                        "tenant": tenant,
+                    }, {
+                        # one refill interval restores one retry token
+                        "Retry-After": str(max(
+                            1, int(1.0 / refill) if refill > 0 else 1
+                        )),
+                    }
                 telemetry.inc("router/failovers")
                 delay = e.retry_after_s \
                     if e.retry_after_s is not None \
@@ -1043,8 +1188,10 @@ class FleetRouter:
         if in_flight:
             # primary outlived the tail cutoff: fire the backup
             hedge_b, hedge_depth, _ = self._pick(key, exclude=tried)
-            if hedge_b is None or not self._spend_retry_token(
-                    ftrace=ftrace, reason="hedge"):
+            if hedge_b is None or self._spend_retry_token(
+                    ftrace=ftrace, reason="hedge",
+                    tenant=str(fwd_body.get("tenant") or "default"),
+            ) is not None:
                 telemetry.inc("router/hedges_suppressed")
                 if ftrace is not None:
                     ftrace.event(
@@ -1125,23 +1272,87 @@ class FleetRouter:
         with self._lock:
             return max(self._latency.p95(), floor)
 
+    def _tenant_bucket(self, tenant: str) -> Optional[RetryBudget]:
+        """The retry-budget slice governing ``tenant`` — its own entry,
+        else the shared ``default`` one, else None (no slices carved).
+        Caller holds ``_lock``."""
+        bucket = self._tenant_budgets.get(tenant)
+        if bucket is None:
+            bucket = self._tenant_budgets.get("default")
+        return bucket
+
+    def _tenant_refill(self, tenant: str) -> float:
+        """The refill rate (tokens/s) of ``tenant``'s budget slice, for
+        Retry-After math; 0 when no slice governs it."""
+        with self._lock:
+            bucket = self._tenant_bucket(tenant)
+            return bucket.refill_per_s if bucket is not None else 0.0
+
+    def _shed_for_pressure(self, tenant: str) -> Optional[int]:
+        """Retry-After seconds when this request should be answered at
+        the router's edge instead of forwarded, None to forward.
+
+        Sheds only BEST-EFFORT tenants (router.tenants priority <= 0;
+        no tenant table or no governing entry = nobody is shed), and
+        only while at least ``shed_pressure_threshold`` of the admitting
+        replicas publish pressure (degraded or brownout on /readyz).
+        The returned pacing is the worst pressured replica's own
+        ``retry_after_s`` — the fleet's estimate of when a slot frees,
+        not a made-up constant. An empty fleet is NOT a shed: the
+        NoBackendAvailable path answers that with better context."""
+        threshold = self.config.shed_pressure_threshold
+        tenants = self.config.tenants
+        if threshold <= 0 or not tenants:
+            return None
+        spec = tenants.get(tenant)
+        if spec is None:
+            spec = tenants.get("default")
+        if spec is None or int(spec.get("priority", 0) or 0) > 0:
+            return None
+        with self._lock:
+            admitted = [b for b in self.backends if b.admitted]
+            if not admitted:
+                return None
+            pressured = [
+                b for b in admitted
+                if b.pressure.get("degraded") or b.pressure.get("brownout")
+            ]
+            if len(pressured) < threshold * len(admitted):
+                return None
+            return max(
+                1,
+                max(int(b.pressure.get("retry_after_s", 1) or 1)
+                    for b in pressured),
+            )
+
     def _spend_retry_token(self, ftrace: Optional[FleetTrace] = None,
-                           reason: str = "failover") -> bool:
-        """Debit the fleet-wide retry budget for one failover or hedge;
-        False = bucket empty, the caller must not retry."""
+                           reason: str = "failover",
+                           tenant: str = "default") -> Optional[str]:
+        """Debit the retry budget for one failover or hedge: the
+        tenant's slice first (when ``router.tenants`` carves them), then
+        the fleet-wide bucket. Returns None when granted, else which
+        bucket refused — ``"tenant"`` or ``"fleet"`` — and the caller
+        must not retry."""
         with self._lock:
             now = monotonic()
+            bucket = self._tenant_bucket(tenant)
+            if bucket is not None and not bucket.try_spend(now):
+                return "tenant"
             ok = self._retry_budget.try_spend(now)
             if self._retry_budget.capacity > 0:
                 telemetry.set_gauge(
                     "router/retry_budget_tokens",
                     self._retry_budget.available(now),
                 )
-        if ok:
-            telemetry.inc("router/retry_budget_spent")
-            if ftrace is not None:
-                ftrace.event("retry_budget_spend", reason=reason)
-        return ok
+        if not ok:
+            return "fleet"
+        telemetry.inc("router/retry_budget_spent")
+        telemetry.inc("router/retry_budget_spent",
+                      labels={"tenant": tenant})
+        if ftrace is not None:
+            ftrace.event("retry_budget_spend", reason=reason,
+                         tenant=tenant)
+        return None
 
     def _record_outcome(self, backend: Backend, ok: bool,
                         ftrace: Optional[FleetTrace] = None) -> None:
@@ -1360,6 +1571,7 @@ class FleetRouter:
         telemetry.set_gauge("router/fleet_size", float(len(self.backends)))
         telemetry.set_gauge("router/admitting", 0.0)
         telemetry.set_gauge("router/degraded_backends", 0.0)
+        telemetry.set_gauge("router/pressured_backends", 0.0)
         telemetry.set_gauge("router/fleet_model_version", 0.0)
         telemetry.set_gauge("router/affinity_hit_rate", 0.0)
         telemetry.set_gauge("router/fleet_goodput", 0.0)

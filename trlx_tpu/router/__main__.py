@@ -8,6 +8,7 @@ docs/source/serving.rst ("Fleet routing").
 """
 
 import argparse
+import json
 import sys
 
 import yaml
@@ -84,6 +85,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-target", type=float, default=None,
                    help="goodput objective for the slo/burn_rate_* "
                         "gauges, e.g. 0.99")
+    p.add_argument("--tenants", default=None,
+                   help="inline JSON per-tenant retry-budget slices, "
+                        "e.g. '{\"premium\": {\"rps\": 2, \"burst\": 4, "
+                        "\"priority\": 10}}' (usually from the YAML "
+                        "router: section instead)")
+    p.add_argument("--shed-pressure-threshold", type=float, default=None,
+                   help="shed best-effort tenants locally when this "
+                        "fraction of admitting replicas publish "
+                        "pressure (<= 0 disables, 1.0 = whole fleet)")
     return p
 
 
@@ -98,6 +108,8 @@ def router_config_from_args(args) -> RouterConfig:
         section["backends"] = [
             b.strip() for b in args.backends.split(",") if b.strip()
         ]
+    if args.tenants is not None:
+        section["tenants"] = json.loads(args.tenants)
     cfg = RouterConfig.from_dict(section)
     for flag in ("host", "port", "page_size", "probe_interval",
                  "request_timeout", "failover_retries", "rollout_timeout",
@@ -106,7 +118,8 @@ def router_config_from_args(args) -> RouterConfig:
                  "breaker_cooldown", "retry_budget",
                  "retry_budget_refill", "hedge_after_s",
                  "trace_ring", "access_log", "access_log_sample",
-                 "access_log_max_mb", "slo_target"):
+                 "access_log_max_mb", "slo_target",
+                 "shed_pressure_threshold"):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, flag, value)

@@ -35,11 +35,20 @@ counts ``fault/stalls`` instead of leaving a silently dead port. The
 ``serve_decode`` chaos seam fires inside that phase so the stall path is
 CPU-testable (trlx_tpu.supervisor.chaos).
 
+Multi-tenant admission (docs "Fault tolerance", overload containment):
+requests carry a tenant identity; a ``serve.tenants`` config attaches
+per-tenant quotas (token-bucket rate, inflight cap, queue share)
+enforced here by :class:`TenantTable` — an over-quota tenant gets a
+typed :class:`QuotaExceeded` (429 + per-tenant ``Retry-After``) while
+other tenants keep being admitted. The ``serve_quota`` chaos seam fires
+on that check so the shed path is drillable.
+
 Metrics (trlx_tpu.telemetry): ``serve/queue_depth`` gauge,
-``serve/batch_fill_ratio`` gauge, ``serve/request_latency`` histogram
-(p50/p95), ``serve/tokens_per_sec`` gauge, and the
+``serve/batch_fill_ratio`` gauge, the path-labeled
+``serve/request_latency{path=...}`` histogram (p50/p95, observed at
+trace completion), ``serve/tokens_per_sec`` gauge, the
 ``serve/requests|responses|batches|rejected|request_errors|generated_tokens``
-counters.
+counters, and the tenant-labeled ``serve/shed_quota{tenant=...}``.
 """
 
 import itertools
@@ -64,6 +73,23 @@ class Draining(QueueFull):
     callers handle both the same way."""
 
 
+class QuotaExceeded(QueueFull):
+    """Per-tenant admission rejection: THIS tenant's quota
+    (``serve.tenants`` rate bucket, ``max_inflight``, or
+    ``max_queue_share``) is exhausted while the server itself may still
+    have room — other tenants keep being admitted. IS-A
+    :class:`QueueFull` (HTTP 429) so scheduler-agnostic callers need no
+    new handling, but carries the tenant name and a per-tenant
+    ``Retry-After`` derived from the tenant's own bucket refill instead
+    of the global queue estimate."""
+
+    def __init__(self, message: str, tenant: str = "",
+                 retry_after_s: int = 1):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_s = int(retry_after_s)
+
+
 class ReplayExhausted(RuntimeError):
     """A request's crash-only replay budget (``serve.max_replays``) ran
     out, or its grown prompt (original + committed tokens) no longer
@@ -86,6 +112,168 @@ class DrainTimeout(RuntimeError):
 #: global admission order: ties in priority admit FIFO by this stamp,
 #: and replayed requests keep their original position
 _SEQ = itertools.count()
+
+#: tenant charged for requests that carry no ``X-Tenant-Id`` header /
+#: ``"tenant"`` body field — quota config for it lives under the
+#: ``serve.tenants`` ``"default"`` entry, which also governs tenants
+#: the config does not name
+DEFAULT_TENANT = "default"
+
+_TENANT_KEYS = ("max_inflight", "max_queue_share", "rps", "burst",
+                "priority")
+
+
+class TenantPolicy:
+    """One parsed ``serve.tenants`` entry.
+
+    ``rps``/``burst`` form a token bucket (``rps <= 0`` disables rate
+    limiting; ``burst <= 0`` defaults to ``max(1, rps)``);
+    ``max_inflight`` caps admitted-but-unfinished requests (``<= 0``
+    unlimited); ``max_queue_share`` caps the fraction of
+    ``serve.max_queue`` the tenant's QUEUED requests may occupy
+    (``<= 0`` unlimited); ``priority`` is the default admission
+    priority for the tenant's requests — ``<= 0`` marks the tenant
+    best-effort, i.e. brownout-clampable and router-sheddable under
+    fleet pressure."""
+
+    __slots__ = ("name", "max_inflight", "max_queue_share", "rps",
+                 "burst", "priority")
+
+    def __init__(self, name: str, spec):
+        spec = dict(spec or {})
+        unknown = sorted(set(spec) - set(_TENANT_KEYS))
+        if unknown:
+            raise ValueError(
+                f"serve.tenants[{name!r}]: unknown keys {unknown} "
+                f"(known: {list(_TENANT_KEYS)})"
+            )
+        self.name = name
+        self.max_inflight = int(spec.get("max_inflight", 0))
+        self.max_queue_share = float(spec.get("max_queue_share", 0.0))
+        if self.max_queue_share > 1.0:
+            raise ValueError(
+                f"serve.tenants[{name!r}].max_queue_share="
+                f"{self.max_queue_share:g} must be <= 1.0 (a fraction "
+                f"of serve.max_queue)"
+            )
+        self.rps = float(spec.get("rps", 0.0))
+        burst = float(spec.get("burst", 0.0))
+        self.burst = burst if burst > 0 else max(1.0, self.rps)
+        self.priority = int(spec.get("priority", 0))
+
+    @property
+    def best_effort(self) -> bool:
+        return self.priority <= 0
+
+
+class TenantTable:
+    """Per-tenant admission accounting shared by both schedulers.
+
+    NOT internally locked: callers invoke it under their own scheduler
+    lock (the same discipline as router/resilience.RetryBudget). The
+    ``"default"`` entry, when present, governs both the default tenant
+    and any tenant the config does not name (they share its bucket);
+    with no ``serve.tenants`` config at all every check is a no-op, so
+    quota-free deployments pay nothing."""
+
+    def __init__(self, config, max_queue: int):
+        config = config or {}
+        self.policies = {
+            str(name): TenantPolicy(str(name), spec)
+            for name, spec in config.items()
+        }
+        self.enabled = bool(self.policies)
+        self.max_queue = int(max_queue)
+        now = monotonic()
+        self._buckets = {n: (p.burst, now)
+                         for n, p in self.policies.items()}
+
+    def policy(self, tenant: str) -> Optional[TenantPolicy]:
+        p = self.policies.get(tenant)
+        return self.policies.get(DEFAULT_TENANT) if p is None else p
+
+    def priority_for(self, tenant: str) -> int:
+        p = self.policy(tenant)
+        return 0 if p is None else p.priority
+
+    def best_effort(self, tenant: str) -> bool:
+        p = self.policy(tenant)
+        return True if p is None else p.best_effort
+
+    def _refill(self, p: TenantPolicy, now: float) -> float:
+        tokens, stamp = self._buckets[p.name]
+        if p.rps > 0 and now > stamp:
+            tokens = min(p.burst, tokens + (now - stamp) * p.rps)
+        self._buckets[p.name] = (tokens, now)
+        return tokens
+
+    def _retry_after(self, p: TenantPolicy, now: float) -> int:
+        """Seconds until the tenant's bucket holds a whole token again
+        — the per-tenant Retry-After hint; >= 1 (HTTP header integer)."""
+        if p.rps <= 0:
+            return 1
+        tokens, _ = self._buckets[p.name]
+        deficit = (1.0 - tokens) / p.rps
+        return max(1, int(-(-deficit // 1)))
+
+    def try_admit(self, tenant: str, queued: int, inflight: int,
+                  now: float) -> Optional[QuotaExceeded]:
+        """One admission attempt for ``tenant`` currently holding
+        ``queued`` queued and ``inflight`` running requests (counted by
+        the caller under its lock). Returns None and spends one bucket
+        token on success, or a ready-to-raise :class:`QuotaExceeded`
+        (no token spent) naming the exhausted quota."""
+        if not self.enabled:
+            return None
+        p = self.policy(tenant)
+        if p is None:
+            return None
+        self._refill(p, now)
+        if p.max_inflight > 0 and queued + inflight >= p.max_inflight:
+            return QuotaExceeded(
+                f"tenant {tenant!r} is at its max_inflight="
+                f"{p.max_inflight} admitted-but-unfinished requests "
+                f"(serve.tenants); retry after in-flight work drains",
+                tenant=tenant, retry_after_s=self._retry_after(p, now),
+            )
+        if p.max_queue_share > 0 and queued >= max(
+            1, int(p.max_queue_share * self.max_queue)
+        ):
+            return QuotaExceeded(
+                f"tenant {tenant!r} holds its full "
+                f"max_queue_share={p.max_queue_share:g} slice of the "
+                f"{self.max_queue}-deep serve queue (serve.tenants); "
+                f"other tenants keep their share — retry with backoff",
+                tenant=tenant, retry_after_s=self._retry_after(p, now),
+            )
+        if p.rps > 0:
+            tokens, _ = self._buckets[p.name]
+            if tokens < 1.0:
+                return QuotaExceeded(
+                    f"tenant {tenant!r} is over its {p.rps:g} rps rate "
+                    f"quota (burst {p.burst:g}, serve.tenants); retry "
+                    f"after the bucket refills",
+                    tenant=tenant,
+                    retry_after_s=self._retry_after(p, now),
+                )
+            self._buckets[p.name] = (tokens - 1.0, now)
+        return None
+
+    def snapshot(self, now: float) -> Dict:
+        """Debug view for ``/debug/state``: per-tenant bucket levels
+        and policy knobs (never mutates bucket stamps)."""
+        out = {}
+        for name, p in self.policies.items():
+            tokens, stamp = self._buckets[name]
+            if p.rps > 0 and now > stamp:
+                tokens = min(p.burst, tokens + (now - stamp) * p.rps)
+            out[name] = {
+                "tokens": round(tokens, 3), "rps": p.rps,
+                "burst": p.burst, "max_inflight": p.max_inflight,
+                "max_queue_share": p.max_queue_share,
+                "priority": p.priority,
+            }
+        return out
 
 
 def _validate_deadline(deadline_ms) -> Optional[float]:
@@ -137,13 +325,14 @@ class Request:
     __slots__ = ("tokens", "max_new_tokens", "seed", "shape",
                  "enqueued_at", "done", "result", "error", "latency_s",
                  "trace", "seq", "priority", "deadline_at", "replays",
-                 "committed", "model_version")
+                 "committed", "model_version", "tenant", "age",
+                 "degraded")
 
     def __init__(self, tokens: List[int], max_new_tokens: int,
                  shape, seed: Optional[int] = None,
                  trace: Optional[RequestTrace] = None,
                  deadline_s: Optional[float] = None,
-                 priority: int = 0):
+                 priority: int = 0, tenant: str = DEFAULT_TENANT):
         self.tokens = tokens
         self.max_new_tokens = max_new_tokens
         self.seed = seed
@@ -162,8 +351,17 @@ class Request:
         self.replays = 0
         self.committed: List[int] = []
         self.model_version = 0  # stamped at admission
+        self.tenant = tenant
+        #: admission rounds spent queued — feeds priority aging
+        #: (serve.priority_aging_rounds) so low-priority tenants cannot
+        #: be starved forever by a saturating high-priority stream
+        self.age = 0
+        #: True when brownout clamped this request's max_new_tokens
+        #: (surfaced as "degraded": true in the HTTP response)
+        self.degraded = False
         if trace is not None:
             trace.enqueued = self.enqueued_at
+            trace.tenant = tenant
 
     def remaining_new_tokens(self) -> int:
         """Decode budget still owed after the committed prefix — always
@@ -197,6 +395,9 @@ class MicroBatcher:
             cfg.max_wait_ms if max_wait_ms is None else max_wait_ms
         ) / 1000.0
         self.max_queue = cfg.max_queue if max_queue is None else max_queue
+        self._tenants = TenantTable(
+            getattr(cfg, "tenants", None), self.max_queue
+        )
         self._tracing = bool(getattr(cfg, "request_tracing", True))
         self._slo_s = float(getattr(cfg, "slo_ttft_ms", 0.0)) / 1000.0
         #: optional trlx_tpu.supervisor.RunSupervisor — ENTERED BY THE
@@ -246,14 +447,18 @@ class MicroBatcher:
                seed: Optional[int] = None,
                trace: Optional[RequestTrace] = None,
                deadline_ms: Optional[float] = None,
-               priority: int = 0) -> Request:
+               priority: Optional[int] = None,
+               tenant: Optional[str] = None) -> Request:
         """Enqueue one request (bucket-rounded); raises ValueError when
-        no lattice bucket fits, QueueFull past ``max_queue``, Draining
+        no lattice bucket fits, QueueFull past ``max_queue``,
+        :class:`QuotaExceeded` when THIS tenant's ``serve.tenants``
+        quota is spent (the global queue may still have room), Draining
         during a graceful drain. An explicit ``trace`` (the HTTP layer's,
         carrying ``received``) is attached as-is; otherwise one is minted
         here when tracing is on. ``deadline_ms`` bounds total queueing:
         a request still queued past it is shed with
-        :class:`DeadlineExceeded` (the static path checks at flush)."""
+        :class:`DeadlineExceeded` (the static path checks at flush).
+        ``priority=None`` takes the tenant's configured default."""
         if not tokens:
             raise ValueError("empty prompt: at least one token is required")
         if max_new_tokens is None:
@@ -262,12 +467,17 @@ class MicroBatcher:
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
         deadline_s = _validate_deadline(deadline_ms)
+        tenant = DEFAULT_TENANT if not tenant else str(tenant)
+        if priority is None:
+            priority = self._tenants.priority_for(tenant)
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
         if trace is None and self._tracing:
             trace = RequestTrace()
         req = Request(list(tokens), max_new_tokens, shape, seed=seed,
                       trace=trace, deadline_s=deadline_s,
-                      priority=priority)
+                      priority=priority, tenant=tenant)
+        if self._tenants.enabled:
+            chaos.maybe_inject("serve_quota")
         with self._cond:
             if self._draining:
                 telemetry.inc("serve/rejected")
@@ -276,6 +486,17 @@ class MicroBatcher:
                     "in-flight requests finish (serve.drain_timeout); "
                     "retry against another replica"
                 )
+            denied = self._tenants.try_admit(
+                tenant,
+                queued=sum(1 for r in self._queue if r.tenant == tenant),
+                inflight=0, now=monotonic(),
+            )
+            if denied is not None:
+                telemetry.inc("serve/rejected")
+                telemetry.inc("serve/shed_quota")
+                telemetry.inc("serve/shed_quota",
+                              labels={"tenant": tenant})
+                raise denied
             if len(self._queue) >= self.max_queue:
                 telemetry.inc("serve/rejected")
                 raise QueueFull(
@@ -362,9 +583,6 @@ class MicroBatcher:
             req.result = self.engine.depad_row(out, i, req.max_new_tokens)
             gen_total += len(req.result)
             req.latency_s = done_at - req.enqueued_at
-            # kept for dashboard continuity; superseded by the
-            # path-labeled serve/request_latency complete() observes
-            telemetry.observe("serve/request_latency", req.latency_s)
             if req.trace is not None:
                 req.trace.note_static_decode(
                     admit_at, done_at, len(req.result)
@@ -423,7 +641,9 @@ class MicroBatcher:
         per_req = 0.05
         tel = telemetry.current()
         if tel is not None:
-            hist = tel.registry.hists.get("serve/request_latency")
+            hist = tel.registry.hists.get(
+                "serve/request_latency{path=static}"
+            )
             if hist is not None and hist.count:
                 per_req = max(hist.quantile(0.5), 1e-3)
         mean_batch = max(

@@ -215,6 +215,23 @@ class ServeConfig:
     attention: str = "jnp"
     kv_dtype: str = "bf16"
     weights_dtype: str = "bf16"
+    #: per-tenant quota table, {tenant: {max_inflight, max_queue_share,
+    #: rps, burst, priority}} — None/{} disables quota enforcement
+    #: entirely (docs "Fault tolerance", overload containment). The
+    #: "default" entry also governs tenants the config does not name.
+    tenants: Optional[Dict[str, Dict[str, Any]]] = None
+    #: brownout degradation: under sustained pressure clamp best-effort
+    #: tenants' max_new_tokens to this many (0 = brownout off)
+    brownout_max_new: int = 0
+    #: pressure must hold this long (s) before brownout engages, and be
+    #: absent for brownout_recover_s before it releases — hysteresis so
+    #: the mode cannot flap with the step-time signal
+    brownout_after_s: float = 2.0
+    brownout_recover_s: float = 5.0
+    #: every this-many admission rounds a queued request gains one
+    #: effective priority level, so a saturating high-priority stream
+    #: cannot starve low-priority tenants forever (0 = aging off)
+    priority_aging_rounds: int = 64
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -377,6 +394,34 @@ class InferenceEngine:
                 f"serve.degrade_step_ms={self.serve.degrade_step_ms} "
                 f"must be >= 0 (0 = step-time degradation signal off)"
             )
+        if self.serve.brownout_max_new < 0:
+            raise ValueError(
+                f"serve.brownout_max_new={self.serve.brownout_max_new} "
+                f"must be >= 0 (0 = brownout degradation off)"
+            )
+        if self.serve.brownout_after_s <= 0:
+            raise ValueError(
+                f"serve.brownout_after_s={self.serve.brownout_after_s} "
+                f"must be > 0 (pressure debounce before brownout)"
+            )
+        if self.serve.brownout_recover_s <= 0:
+            raise ValueError(
+                f"serve.brownout_recover_s="
+                f"{self.serve.brownout_recover_s} must be > 0 "
+                f"(hysteresis: calm time required before recovery)"
+            )
+        if self.serve.priority_aging_rounds < 0:
+            raise ValueError(
+                f"serve.priority_aging_rounds="
+                f"{self.serve.priority_aging_rounds} must be >= 0 "
+                f"(0 = priority aging off)"
+            )
+        if self.serve.tenants is not None:
+            # parse eagerly so a bad tenants block fails at boot with a
+            # config-shaped error, not at first admission
+            from trlx_tpu.serve.batcher import TenantTable
+
+            TenantTable(self.serve.tenants, self.serve.max_queue)
         if self.serve.mesh_weights not in ("fsdp", "replicated"):
             raise ValueError(
                 f"serve.mesh_weights '{self.serve.mesh_weights}' is not "

@@ -81,6 +81,7 @@ from trlx_tpu.serve.batcher import (
     DrainTimeout,
     MicroBatcher,
     QueueFull,
+    QuotaExceeded,
     ReplayExhausted,
 )
 from trlx_tpu.serve.trace import SLO_COUNTERS, RequestTrace
@@ -125,6 +126,13 @@ _SERVE_COUNTERS = (
     # proxy hygiene (fleet routing, docs "Serving"): requests rejected
     # past the X-Hop-Count cap — a climbing counter means a routing loop
     "serve/hop_limit_rejects",
+    # overload containment (docs "Fault tolerance"): per-tenant quota
+    # sheds (also labeled {tenant=...}), brownout max_new_tokens clamps,
+    # and brownout mode engagements — the tenant-labeled twins appear on
+    # first increment (labels cannot be predeclared)
+    "serve/shed_quota",
+    "serve/brownout_clamped",
+    "serve/brownout_entries",
 )
 
 #: proxy-hop ceiling: any sane fleet topology is 1-2 hops deep (client
@@ -208,12 +216,19 @@ class _Handler(BaseHTTPRequestHandler):
             # replica answers 503 here while /healthz stays 200, so the
             # orchestrator rotates it without killing in-flight work
             ready = srv.warmed and not srv.draining
-            self._json(200 if ready else 503, {
+            body = {
                 "ready": ready,
                 "warmed": srv.warmed,
                 "draining": srv.draining,
                 "model_version": srv.engine.model_version,
-            })
+            }
+            # backpressure block (overload containment): the router's
+            # prober reads this to shed best-effort tenants BEFORE
+            # forwarding into a page-starved/browned-out replica
+            pressure_fn = getattr(srv.batcher, "pressure", None)
+            if pressure_fn is not None:
+                body["pressure"] = pressure_fn()
+            self._json(200 if ready else 503, body)
         elif self.path == "/debug/state":
             state_fn = getattr(srv.batcher, "debug_state", None)
             if state_fn is not None:
@@ -307,15 +322,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no POST route '{self.path}' (have "
                              f"/generate, /admin/drain, /admin/reload)")
             return
+        tenant = self.headers.get("X-Tenant-Id") or None
         try:
             payload = bounded_call(
                 lambda: srv.handle_generate(
                     body, trace_id=request_id, received_at=received_at,
-                    hops=hops,
+                    hops=hops, tenant=tenant,
                 ),
                 timeout=srv.engine.serve.request_timeout,
                 label="serve_request",
             )
+        except QuotaExceeded as e:
+            # per-TENANT admission control: Retry-After comes from the
+            # tenant's own bucket refill, not the global queue estimate
+            # (other tenants are still being admitted)
+            self._json(429, {"error": str(e), "tenant": e.tenant},
+                       headers={"Retry-After": str(e.retry_after_s)})
+            return
         except QueueFull as e:
             # admission control (queue full OR draining): tell the
             # client WHEN to come back — queue depth x recent step p50
@@ -432,14 +455,20 @@ class InferenceServer:
 
     def handle_generate(self, body: dict, trace_id: Optional[str] = None,
                         received_at: Optional[float] = None,
-                        hops: int = 0) -> dict:
+                        hops: int = 0,
+                        tenant: Optional[str] = None) -> dict:
         """One request end-to-end: tokenize, submit, wait, shape the
         response. Runs inside bounded_call — raising is the error path
         (the handler maps exception types to HTTP codes). ``trace_id``,
-        ``received_at``, and ``hops`` (the inbound ``X-Hop-Count``, 0 =
-        no proxy in front) come from the HTTP edge; direct callers may
-        omit all three (the scheduler mints a trace at submit)."""
+        ``received_at``, ``hops`` (the inbound ``X-Hop-Count``, 0 =
+        no proxy in front), and ``tenant`` (the ``X-Tenant-Id`` header;
+        the JSON ``"tenant"`` field is the headerless fallback) come
+        from the HTTP edge; direct callers may omit all of them (the
+        scheduler mints a trace at submit and charges the default
+        tenant)."""
         chaos.maybe_inject("serve_request")
+        if tenant is None and body.get("tenant") is not None:
+            tenant = str(body["tenant"])
         if "tokens" in body:
             tokens = [int(t) for t in body["tokens"]]
         elif "prompt" in body:
@@ -453,12 +482,14 @@ class InferenceServer:
         trace = None
         if self.engine.serve.request_tracing:
             trace = RequestTrace(trace_id=trace_id, received=received_at)
+        priority = body.get("priority")
         req = self.batcher.submit(
             tokens, max_new_tokens=max_new,
             seed=None if seed is None else int(seed),
             trace=trace,
             deadline_ms=None if deadline_ms is None else float(deadline_ms),
-            priority=int(body.get("priority", 0)),
+            priority=None if priority is None else int(priority),
+            tenant=tenant,
         )
         req.wait()  # bounded by the caller's bounded_call
         payload = {
@@ -471,6 +502,10 @@ class InferenceServer:
             "queue_depth": self.batcher.queue_depth(),
             "model_version": req.model_version,
         }
+        if req.degraded:
+            # brownout clamped this request's max_new_tokens — a partial
+            # answer, declared so the client can tell it from a full one
+            payload["degraded"] = True
         if req.trace is not None:
             req.trace.responded = monotonic()
             payload["trace_id"] = req.trace.trace_id

@@ -65,10 +65,22 @@ the paged-pool family (``serve/prefix_tokens_saved`` /
 ``serve/prefix_hit_rate`` / ``serve/pages_per_request_p95`` gauges,
 ``serve/pages_per_request`` histogram), plus the shared
 ``serve/requests|responses|rejected|request_errors|generated_tokens``
-family and ``serve/request_latency`` histogram. The old
-batch-to-completion path stays available as ``serve.scheduler: static``
-for A/B (bench.py replays the same mixed-length trace against both
-schedulers and both KV layouts).
+family and the path-labeled ``serve/request_latency{path=slots}``
+histogram. The old batch-to-completion path stays available as
+``serve.scheduler: static`` for A/B (bench.py replays the same
+mixed-length trace against both schedulers and both KV layouts).
+
+Overload containment (docs "Fault tolerance"): requests carry a tenant;
+``serve.tenants`` quotas are enforced at :meth:`SlotScheduler.submit`
+(typed :class:`QuotaExceeded` 429s with per-tenant ``Retry-After``,
+``serve/shed_quota{tenant=...}``), priority admission ages queued
+requests (``serve.priority_aging_rounds``) so low-priority tenants
+cannot starve, and sustained pressure (the :meth:`_degraded` signal
+held for ``serve.brownout_after_s``) enters a hysteretic BROWNOUT that
+clamps best-effort tenants' ``max_new_tokens`` to
+``serve.brownout_max_new`` — partial answers before typed sheds. The
+:meth:`pressure` block is published on ``/readyz`` + ``/debug/state``
+so the fleet router can shed upstream before forwarding.
 """
 
 import threading
@@ -79,11 +91,13 @@ import numpy as np
 
 from trlx_tpu import supervisor, telemetry
 from trlx_tpu.serve.batcher import (
+    DEFAULT_TENANT,
     Draining,
     DrainTimeout,
     QueueFull,
     ReplayExhausted,
     Request,
+    TenantTable,
     _validate_deadline,
     shed_expired,
 )
@@ -491,6 +505,27 @@ class SlotScheduler:
         self._pending_swap: Optional[Dict] = None  # guarded-by: _cond
         self._last_step_ms = 0.0
         self._replayed_requests = 0  # lifetime; /debug/state + bench
+        # -- overload containment (docs "Fault tolerance") -------------- #
+        #: per-tenant quota table; no serve.tenants config = every check
+        #: is a no-op (guarded-by: _cond, like the queue it meters)
+        self.tenants = TenantTable(
+            getattr(cfg, "tenants", None), self.max_queue
+        )
+        self._aging_rounds = int(getattr(cfg, "priority_aging_rounds", 0))
+        #: brownout state machine (worker-written, HTTP-read; a stale
+        #: read only mis-times one clamp): pressure held for
+        #: brownout_after_s -> clamp best-effort tenants; calm for
+        #: brownout_recover_s -> recover. Stamps are monotonic() or 0.
+        self._brownout = False
+        self._pressure_since = 0.0
+        self._calm_since = 0.0
+        self._brownout_max_new = int(getattr(cfg, "brownout_max_new", 0))
+        self._brownout_after_s = float(
+            getattr(cfg, "brownout_after_s", 2.0)
+        )
+        self._brownout_recover_s = float(
+            getattr(cfg, "brownout_recover_s", 5.0)
+        )
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -544,7 +579,8 @@ class SlotScheduler:
                seed: Optional[int] = None,
                trace: Optional[RequestTrace] = None,
                deadline_ms: Optional[float] = None,
-               priority: int = 0) -> Request:
+               priority: Optional[int] = None,
+               tenant: Optional[str] = None) -> Request:
         """Enqueue one request; same validation/admission contract as the
         static micro-batcher (ValueError when no bucket fits, QueueFull
         past ``max_queue``, Draining during a graceful drain). ``seed``
@@ -555,10 +591,18 @@ class SlotScheduler:
         Overload control: ``deadline_ms`` bounds queueing — a request
         still queued past it is shed (DeadlineExceeded, 503) at the next
         admission scan instead of decoded uselessly; higher ``priority``
-        admits first (ties FIFO). When the engine is degraded (slot/page
+        admits first (ties FIFO; ``None`` takes the tenant's configured
+        default, and queued requests AGE upward every
+        ``serve.priority_aging_rounds`` admission scans so nothing
+        starves forever). Per-tenant ``serve.tenants`` quotas reject
+        over-quota tenants with a typed :class:`QuotaExceeded` (429 +
+        the tenant's own ``Retry-After``) while the rest of the fleet
+        keeps being admitted. When the engine is degraded (slot/page
         starvation, or a step over ``serve.degrade_step_ms``) the
-        effective queue bound halves — adaptive admission sheds load at
-        the door while the backlog is least likely to drain."""
+        effective queue bound halves — and pressure SUSTAINED for
+        ``serve.brownout_after_s`` enters brownout, clamping best-effort
+        tenants' ``max_new_tokens`` to ``serve.brownout_max_new``
+        (response flag ``"degraded": true``) before shedding them."""
         if not tokens:
             raise ValueError("empty prompt: at least one token is required")
         if max_new_tokens is None:
@@ -567,6 +611,21 @@ class SlotScheduler:
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens={max_new_tokens} must be >= 1")
         deadline_s = _validate_deadline(deadline_ms)
+        tenant = DEFAULT_TENANT if not tenant else str(tenant)
+        if priority is None:
+            priority = self.tenants.priority_for(tenant)
+        # brownout clamp BEFORE bucket rounding so the clamped request
+        # also reserves the smaller shape class (and fewer KV pages)
+        browned_out = (
+            self._brownout and self._brownout_max_new > 0
+            and self.tenants.best_effort(tenant)
+            and max_new_tokens > self._brownout_max_new
+        )
+        if browned_out:
+            max_new_tokens = self._brownout_max_new
+            telemetry.inc("serve/brownout_clamped")
+            telemetry.inc("serve/brownout_clamped",
+                          labels={"tenant": tenant})
         shape = self.engine.pick_shape(len(tokens), max_new_tokens)
         if self.cache is not None:
             need = self.engine.request_page_need(
@@ -583,7 +642,10 @@ class SlotScheduler:
             trace = RequestTrace()
         req = Request(list(tokens), max_new_tokens, shape, seed=seed,
                       trace=trace, deadline_s=deadline_s,
-                      priority=priority)
+                      priority=priority, tenant=tenant)
+        req.degraded = browned_out
+        if self.tenants.enabled:
+            chaos.maybe_inject("serve_quota")
         with self._cond:
             if self._draining:
                 telemetry.inc("serve/rejected")
@@ -592,6 +654,23 @@ class SlotScheduler:
                     "in-flight requests finish (serve.drain_timeout); "
                     "retry against another replica"
                 )
+            denied = self.tenants.try_admit(
+                tenant,
+                queued=sum(
+                    1 for r in self._queue if r.tenant == tenant
+                ),
+                inflight=sum(
+                    1 for s in list(self._live.values())
+                    if s.request.tenant == tenant
+                ),
+                now=monotonic(),
+            )
+            if denied is not None:
+                telemetry.inc("serve/rejected")
+                telemetry.inc("serve/shed_quota")
+                telemetry.inc("serve/shed_quota",
+                              labels={"tenant": tenant})
+                raise denied
             cap = self.max_queue
             if self._degraded():
                 cap = max(1, self.max_queue // 2)
@@ -621,6 +700,53 @@ class SlotScheduler:
             return True
         limit_ms = float(getattr(self.engine.serve, "degrade_step_ms", 0.0))
         return bool(limit_ms > 0 and self._last_step_ms > limit_ms)
+
+    def _update_brownout(self, now: float) -> None:
+        """Hysteretic brownout state machine, advanced once per worker
+        iteration: the :meth:`_degraded` pressure signal must hold
+        continuously for ``serve.brownout_after_s`` before brownout
+        engages, and be absent continuously for
+        ``serve.brownout_recover_s`` before it releases — a flapping
+        signal moves neither edge. Gauge ``serve/brownout`` tracks the
+        mode; ``serve/brownout_entries`` counts engagements."""
+        if self._brownout_max_new <= 0:
+            return
+        if self._degraded():
+            self._calm_since = 0.0
+            if self._pressure_since == 0.0:
+                self._pressure_since = now
+            elif (not self._brownout
+                  and now - self._pressure_since >= self._brownout_after_s):
+                self._brownout = True
+                telemetry.inc("serve/brownout_entries")
+                telemetry.set_gauge("serve/brownout", 1)
+        else:
+            self._pressure_since = 0.0
+            if not self._brownout:
+                self._calm_since = 0.0
+            elif self._calm_since == 0.0:
+                self._calm_since = now
+            elif now - self._calm_since >= self._brownout_recover_s:
+                self._brownout = False
+                telemetry.set_gauge("serve/brownout", 0)
+
+    def pressure(self) -> Dict:
+        """The published backpressure block (``/readyz`` +
+        ``/debug/state``): one JSON object the fleet router's prober
+        reads to shed best-effort traffic LOCALLY (cheap 429 +
+        Retry-After) instead of forwarding a doomed hop. Lock-free
+        reads — a slightly stale view only mis-times one shed."""
+        out = {
+            "degraded": self._degraded(),
+            "brownout": self._brownout,
+            "starved": self._starved,
+            "queue_depth": len(self._queue),
+            "free_slots": len(self._free),
+            "retry_after_s": self.retry_after_s(),
+        }
+        if self.cache is not None:
+            out["pages_free"] = self.cache.free_pages()
+        return out
 
     def step_p50_s(self) -> float:
         """Recent decode-step p50 (the ``time/serve/slot_step``
@@ -652,10 +778,27 @@ class SlotScheduler:
         here (DeadlineExceeded, ``serve/shed_expired``) before any slot
         is spent on them. Sets ``_starved`` when requests are left
         waiting with no free slot (or, paged, no obtainable page) — the
-        next step then counts as ``serve/preempted_steps``."""
-        by_prio = lambda r: (-r.priority, r.seq)  # noqa: E731
+        next step then counts as ``serve/preempted_steps``.
+
+        Priority aging: every scan bumps each queued request's ``age``;
+        the effective priority is ``priority + age //
+        serve.priority_aging_rounds`` (0 rounds = aging off), so a
+        saturating high-priority stream raises — never pins — the wait
+        of low-priority tenants (the starvation regression test bounds
+        it)."""
+        aging = self._aging_rounds
+
+        def by_prio(r):
+            boost = r.age // aging if aging > 0 else 0
+            return (-(r.priority + boost), r.seq)
+
+        first_scan = True
         while True:
             with self._cond:
+                if first_scan:
+                    first_scan = False
+                    for r in self._queue:
+                        r.age += 1
                 if self._queue:
                     survivors = shed_expired(list(self._queue), monotonic())
                     if len(survivors) != len(self._queue):
@@ -740,6 +883,8 @@ class SlotScheduler:
             self.events.append(("admit", s, r))
         self._fr_admitted += len(batch)
         telemetry.inc("serve/admissions", len(batch))
+        for r in batch:
+            telemetry.inc("serve/admissions", labels={"tenant": r.tenant})
         telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
         return True
 
@@ -850,6 +995,9 @@ class SlotScheduler:
         if saved:
             telemetry.inc("serve/prefix_tokens_saved", saved)
         telemetry.inc("serve/admissions", len(plans))
+        for p in plans:
+            telemetry.inc("serve/admissions",
+                          labels={"tenant": p[0].tenant})
         telemetry.set_gauge("serve/slot_occupancy", self._occupancy())
         self._emit_pool_gauges()
         return not deferred
@@ -924,9 +1072,6 @@ class SlotScheduler:
                 req = live.request
                 req.result = live.tokens
                 req.latency_s = done_at - req.enqueued_at
-                # kept for dashboard continuity; superseded by the
-                # path-labeled serve/request_latency complete() observes
-                telemetry.observe("serve/request_latency", req.latency_s)
                 if req.trace is not None:
                     req.trace.harvested = done_at
                     req.trace.complete("slots", self._slo_s)
@@ -1315,6 +1460,7 @@ class SlotScheduler:
                 "max_new_tokens": req.max_new_tokens,
                 "tokens_emitted": len(live.tokens),
                 "pages": len(live.pages),
+                "tenant": req.tenant,
             }
         return {
             "scheduler": "slots",
@@ -1323,6 +1469,11 @@ class SlotScheduler:
             "free_slots": len(self._free),
             "starved": self._starved,
             "degraded": self._degraded(),
+            "pressure": self.pressure(),
+            "tenants": (
+                self.tenants.snapshot(monotonic())
+                if self.tenants.enabled else {}
+            ),
             "draining": self._draining,
             "model_version": self.engine.model_version,
             "replayed_requests": self._replayed_requests,
@@ -1351,6 +1502,7 @@ class SlotScheduler:
                     swap_pending = self._pending_swap is not None
                     draining = self._draining
                     queue_empty = not self._queue
+                self._update_brownout(monotonic())
                 if swap_pending:
                     # admission pauses so _live can empty; queued +
                     # in-flight requests finish on the ADMITTED version

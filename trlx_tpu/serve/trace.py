@@ -215,7 +215,7 @@ class RequestTrace:
         "harvested", "responded", "queue_reentries", "pages_reserved",
         "prefix_blocks_hit", "bucket", "suffix_len",
         "itl_count", "itl_total", "itl_min", "itl_max",
-        "replays", "model_version",
+        "replays", "model_version", "tenant",
     )
 
     def __init__(self, trace_id: Optional[str] = None,
@@ -246,6 +246,9 @@ class RequestTrace:
         #: the weight generation that ADMITTED this request (hot-swap
         #: audit trail; engine.model_version at admission)
         self.model_version = 0
+        #: tenant charged for this request (overload containment; set at
+        #: submit; feeds slo/goodput_5m{tenant=...} at completion)
+        self.tenant = "default"
 
     # -- lifecycle edges -------------------------------------------------- #
 
@@ -327,6 +330,13 @@ class RequestTrace:
         tel.registry.set_gauge("serve/goodput", good / max(total, 1.0))
         slo_engine().record(
             ok, now=self.harvested or None, labels={"path": path}
+        )
+        # second label axis, not a combined set: per-tenant goodput
+        # (slo/goodput_5m{tenant=...}) must aggregate across paths for
+        # the isolation drill's premium-tenant floor
+        slo_engine().record(
+            ok, now=self.harvested or None,
+            labels={"tenant": self.tenant},
         )
         self._export_spans(tel.tracer)
 

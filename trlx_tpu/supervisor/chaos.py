@@ -29,7 +29,11 @@ A schedule is a ``;``-separated list of rules::
   prefix walk / page allocation — a ``hang`` proves a wedged
   prefix-match is a watchdog-attributable ``serve_admit`` stall, not
   silence), ``serve_request`` (fired at request-handler entry — an
-  ``exc`` surfaces as the HTTP 500 error path), ``serve_replay`` (fired
+  ``exc`` surfaces as the HTTP 500 error path), ``serve_quota`` (fired
+  at submit-time tenant-quota evaluation, only when ``serve.tenants``
+  is configured, before the scheduler lock — an ``exc`` proves an
+  admission-control fault surfaces as that request's typed error, never
+  a wedged queue or a lost request), ``serve_replay`` (fired
   at poisoned-step RECOVERY entry, before any state mutation — an
   ``exc`` there is the double-fault drill: replay is abandoned and the
   in-flight batch fails like pre-replay containment), and
@@ -108,6 +112,7 @@ KNOWN_SEAMS = (
     "serve_prefix_match",
     "serve_decode",
     "serve_request",
+    "serve_quota",
     "serve_replay",
     "serve_reload",
     # fleet-router seams (trlx_tpu.router; see the docstring's seam tour)
