@@ -4,7 +4,7 @@
 # rules — JAX hazards, lock discipline, telemetry/chaos contracts, and
 # the core style subset — with zero dependencies, so it runs everywhere.
 
-.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos obs overload overload-drill
+.PHONY: style check lint test faults telemetry chaos serve serve-mesh serve-soak serve-chaos router kernels defense fleet-chaos obs overload overload-drill spec
 
 # graftlint: the repo's AST invariant checker (docs "Static analysis").
 # Exit 1 on any finding; `python -m trlx_tpu.analysis --list-rules` for
@@ -15,7 +15,7 @@
 lint:
 	python -m trlx_tpu.analysis --budget 10
 
-check: lint kernels defense obs overload
+check: lint kernels defense obs overload spec
 	@command -v ruff >/dev/null 2>&1 \
 		&& ruff check trlx_tpu tests examples bench.py __graft_entry__.py \
 		|| true
@@ -183,9 +183,23 @@ overload-drill:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py \
 		-q -m slow
 
+# speculative-decoding tier (trlx_tpu/serve/speculate.py + the
+# verify_step executable, docs "Serving" > "Speculative decoding"):
+# n-gram index / radix peek proposal semantics, the greedy-parity sweep
+# (speculation on == off bit-identical across page sizes x KV dtypes x
+# staggered admission, zero recompiles), the >= 1.5 effective-tokens-
+# per-step floor on repetitive traces, serve_speculate chaos drills
+# (exc = clean fallback to plain decode, hang = watchdog-attributable
+# serve_decode stall), poisoned-step speculation-state reset, the
+# draft-model tier, and the config/CLI gating. CPU-cheap, so it gates
+# `make check`; the slow speculation soak rides `make serve-soak`.
+spec:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_speculation.py \
+		-q -m 'not slow'
+
 serve-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_slots.py \
-		tests/test_paged.py -q -m slow
+		tests/test_paged.py tests/test_speculation.py -q -m slow
 
 # crash-only lifecycle soak: waves of mixed traffic with injected
 # poisoned steps/admissions and a live hot-swap (zero lost requests,

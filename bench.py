@@ -1113,6 +1113,15 @@ def bench_serving(n_requests=96, trace_seed=17):
     answers). Zero lost accepted requests and zero recompiles are
     asserted, not reported.
 
+    Leg 8 — speculation A/B: the mixed and shared-prefix traces on a
+    greedy twin of the engine config (speculative decoding requires
+    greedy decode), ``serve.speculation: lookup`` (draft-free n-gram
+    proposals, batched multi-token verification) vs ``off``. Reports
+    ``serve_spec_acceptance_rate``,
+    ``serve_spec_effective_tokens_per_step`` (useful tokens per
+    supervised decode step — the step-compression headline), and the
+    tok/s ratio vs the non-speculative greedy paged baseline.
+
     Every leg also reports ``serve_decode_mfu`` (None off-TPU, where no
     bf16 peak is defined) and the request-lifecycle SLO metrics
     (trlx_tpu.serve.trace): ``serve_ttft_p50/p95_ms`` and
@@ -1206,7 +1215,7 @@ def bench_serving(n_requests=96, trace_seed=17):
         itls = [r.trace.itl_mean() for r in reqs
                 if r.trace is not None and r.trace.itl_count]
         return {
-            "tok_s": tokens_out / dt,
+            "tok_s": tokens_out / dt, "tokens": tokens_out,
             "p50": pct_ms(lat, 0.50), "p95": pct_ms(lat, 0.95),
             "ttft_p50": pct_ms(ttfts, 0.50),
             "ttft_p95": pct_ms(ttfts, 0.95),
@@ -1407,6 +1416,79 @@ def bench_serving(n_requests=96, trace_seed=17):
         f"{len(recovered)}/{len(reqs)} requests recovered via replay, "
         f"{replay_saved} replay prefill tokens mapped through the "
         f"prefix cache, 0 lost")
+
+    # speculation A/B: speculative decoding requires greedy decode (the
+    # verification rule is what keeps spec-on output bit-identical to
+    # spec-off), so this leg builds a greedy twin of the bench config —
+    # same weights (same seed/spec), same paged geometry — and replays
+    # the mixed AND shared-prefix traces with serve.speculation off then
+    # lookup. The headline is effective tokens per target step (useful
+    # tokens / supervised decode steps: a plain step commits <= 1
+    # token/slot, a verify step commits the accepted prefix + 1); the
+    # tok/s ratio additionally carries the verify-pass overhead, which
+    # on CPU overstates the cost of the wider (K+1)-token pass.
+    import copy as _copy
+
+    greedy_dict = _copy.deepcopy(config.to_nested_dict())
+    greedy_dict["method"]["gen_kwargs"]["do_sample"] = False
+    greedy_config = TRLConfig.from_dict(greedy_dict)
+
+    def replay_speculation(buckets, reqs_trace, speculation):
+        telemetry.start()
+        eng = InferenceEngine(greedy_config, serve=ServeConfig(
+            buckets=buckets, max_wait_ms=8.0,
+            max_queue=max(256, n_requests), scheduler="slots", slots=16,
+            kv_layout="paged", page_size=16, speculation=speculation,
+            spec_k=4,
+        ))
+        sched = SlotScheduler(eng)
+        sched.warmup()
+        sched.start()
+        try:
+            leg = replay(sched, reqs_trace)
+        finally:
+            sched.stop()
+        reg = telemetry.current().registry
+        if int(reg.counters.get("compile/recompiles", 0.0)):
+            raise RuntimeError(
+                f"speculation leg ({speculation}) recompiled in steady "
+                f"state — verify_step must stay one warm executable"
+            )
+        steps = sum(
+            reg.hists[k].count
+            for k in ("time/serve/slot_step", "time/serve/spec_verify")
+            if k in reg.hists
+        )
+        proposed = reg.counters.get("serve/spec_proposed", 0.0)
+        leg["eff_tok_step"] = leg["tokens"] / max(steps, 1)
+        leg["acceptance"] = (
+            reg.counters.get("serve/spec_accepted", 0.0)
+            / max(proposed, 1.0)
+        )
+        return leg
+
+    spec_off = replay_speculation(serve_cfg.buckets, trace, "off")
+    spec_on = replay_speculation(serve_cfg.buckets, trace, "lookup")
+    spec_prefix_off = replay_speculation(
+        prefix_cfg.buckets, prefix_trace, "off"
+    )
+    spec_prefix_on = replay_speculation(
+        prefix_cfg.buckets, prefix_trace, "lookup"
+    )
+    spec_vs_off = spec_on["tok_s"] / max(spec_off["tok_s"], 1e-9)
+    spec_prefix_vs_off = (
+        spec_prefix_on["tok_s"] / max(spec_prefix_off["tok_s"], 1e-9)
+    )
+    log(f"serve[spec/mixed]: {spec_on['tok_s']:,.1f} useful tok/s "
+        f"({spec_vs_off:.2f}x non-spec greedy paged), acceptance "
+        f"{spec_on['acceptance']:.2f}, "
+        f"{spec_on['eff_tok_step']:.2f} tokens/step vs "
+        f"{spec_off['eff_tok_step']:.2f} plain")
+    log(f"serve[spec/prefix]: {spec_prefix_on['tok_s']:,.1f} useful "
+        f"tok/s ({spec_prefix_vs_off:.2f}x non-spec), acceptance "
+        f"{spec_prefix_on['acceptance']:.2f}, "
+        f"{spec_prefix_on['eff_tok_step']:.2f} tokens/step vs "
+        f"{spec_prefix_off['eff_tok_step']:.2f} plain")
 
     # overload leg: three tenants on the SAME paged engine — premium
     # (quota headroom + priority), standard (best-effort, shares the
@@ -1654,6 +1736,38 @@ def bench_serving(n_requests=96, trace_seed=17):
         "serve_prefix_workload": (
             f"{n_requests}-request burst, 4 shared 48-token system "
             f"prompts + 2..8-token unique tails, paged page_size=16"
+        ),
+        # speculation A/B: draft-free prompt-lookup speculation vs the
+        # plain greedy paged baseline on the same traces/weights
+        "serve_spec_tokens_per_sec": round(spec_on["tok_s"], 1),
+        "serve_spec_vs_baseline": round(spec_vs_off, 3),
+        "serve_spec_acceptance_rate": round(spec_on["acceptance"], 3),
+        "serve_spec_effective_tokens_per_step": round(
+            spec_on["eff_tok_step"], 3
+        ),
+        "serve_spec_baseline_tokens_per_step": round(
+            spec_off["eff_tok_step"], 3
+        ),
+        "serve_spec_prefix_tokens_per_sec": round(
+            spec_prefix_on["tok_s"], 1
+        ),
+        "serve_spec_prefix_vs_baseline": round(spec_prefix_vs_off, 3),
+        "serve_spec_prefix_acceptance_rate": round(
+            spec_prefix_on["acceptance"], 3
+        ),
+        "serve_spec_prefix_effective_tokens_per_step": round(
+            spec_prefix_on["eff_tok_step"], 3
+        ),
+        "serve_decode_mfu_spec": decode_mfu(spec_on),
+        "serve_decode_mfu_spec_baseline": decode_mfu(spec_off),
+        "serve_decode_mfu_spec_prefix": decode_mfu(spec_prefix_on),
+        "serve_spec_workload": (
+            "the mixed and shared-prefix traces on a greedy twin of the "
+            "bench engine (speculation requires greedy decode), "
+            "serve.speculation lookup (spec_k=4, draft-free n-gram "
+            "proposals) vs off; effective tokens/step counts useful "
+            "tokens over supervised decode steps (slot_step + "
+            "spec_verify), zero recompiles asserted per leg"
         ),
         # overload leg: per-tenant quotas + brownout under a 4x-quota
         # aggressor (docs "Fault tolerance", overload containment)

@@ -531,6 +531,7 @@ def test_soak_paged_no_recompiles_no_page_leaks(fresh_registry):
         assert all(len(r.result) <= r.max_new_tokens for r in reqs)
         assert s.queue_depth() == 0
         assert s.free_slots() == s.runtime.num_slots, "slot leak"
+        assert not s._speculators, "leaked per-slot speculator state"
         stats = s.pool_stats()
         assert stats["pages_free"] + stats["pages_cached"] == \
             stats["pages_total"], "page leak"

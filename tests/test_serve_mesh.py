@@ -174,6 +174,35 @@ def test_tp2_pallas_kernel_greedy_parity(serve_mesh_devices):
         telemetry.start()
 
 
+def test_tp2_speculation_greedy_parity(serve_mesh_devices):
+    """Speculative decoding (``serve.speculation: lookup``) under a tp=2
+    mesh emits greedy tokens bit-identical to the single-device oracle —
+    the batched ``verify_step`` executable shards exactly like
+    ``decode_step`` (pool head-sharded, candidates replicated), so the
+    parity invariant holds through the speculation tier with zero
+    recompiles and zero leaks."""
+    registry = telemetry.start().registry
+    want = expected_rows()
+    engine = mesh_engine(mesh={"tp": 2}, speculation="lookup", spec_k=4)
+    assert engine.mesh.size == 2
+    s = SlotScheduler(engine)
+    s.warmup()
+    s.start()
+    try:
+        got = run_staggered(s)
+        assert got == want, (
+            "tp=2 speculative outputs diverged from the single-device "
+            "oracle"
+        )
+        assert registry.counters.get("compile/recompiles", 0.0) == 0.0
+        assert registry.counters.get("serve/spec_fallbacks", 0.0) == 0.0
+        assert not s._speculators  # released at harvest
+        assert_no_leaks(s)
+    finally:
+        s.stop()
+        telemetry.start()
+
+
 # --------------------------------------------------------------------- #
 # crash-only invariants under the mesh
 # --------------------------------------------------------------------- #

@@ -511,6 +511,7 @@ def test_soak_mixed_lengths_no_recompiles_no_leaks(engine, fresh_registry):
         assert all(len(r.result) <= r.max_new_tokens for r in reqs)
         assert s.queue_depth() == 0
         assert s.free_slots() == s.runtime.num_slots, "slot leak"
+        assert not s._speculators, "leaked per-slot speculator state"
         assert fresh_registry.counters.get("compile/recompiles", 0.0) == 0.0
         assert fresh_registry.counters["serve/admissions"] == 300.0
         assert fresh_registry.counters["serve/evictions"] == 300.0

@@ -924,6 +924,215 @@ def _prefill_into_pages(
     return tuple(new_pool), new_state
 
 
+def verify_step(
+    spec: ModelSpec,
+    blocks: Params,
+    embed: Params,
+    ln_f: Params,
+    pool,
+    state: SlotState,
+    seed: jnp.ndarray,  # scalar int32 (per-step sampling stream)
+    proposals: jnp.ndarray,  # [S, K] int32 speculated continuation tokens
+    n_proposed: jnp.ndarray,  # [S] int32 how many of each row are real
+    config: GenerationConfig,
+    compute_dtype=jnp.bfloat16,
+    attention_fn=attention_scores,
+):
+    """Speculative-decoding verification: score K proposed tokens per
+    slot in ONE batched pass and emit the longest greedy-matching prefix
+    plus the free token — ``prefill_suffix`` generalized to the decode
+    loop (the speculation tentpole; host side in trlx_tpu.serve.slots).
+
+    Per slot the candidate row is ``[t0, proposals...]`` where ``t0`` is
+    the token the slot's CARRIED logits emit (exactly what
+    :func:`decode_step` would produce this step — the always-free
+    token). All K+1 candidates are forwarded together at logical
+    positions ``pos + j``, attending over the committed pool positions
+    (``state.valid``) plus the candidates before them — the same
+    logical-causality bias the prefix-suffix prefill builds, so the
+    per-position logits are bit-identical to K+1 sequential
+    ``decode_step`` calls under greedy decode. Proposal ``j`` is
+    accepted iff it equals the argmax of the distribution following
+    candidate ``j-1`` and every earlier proposal was accepted; the
+    emitted run is ``cand[:count]`` (eos truncates it and finishes the
+    slot, as does the per-slot ``max_new`` budget).
+
+    Rejected candidates need no KV copy-back: their pool writes landed
+    through the slot's OWN reserved pages (never radix-shared — the trie
+    only holds whole committed prompt blocks), and the final ``valid``
+    lanes mark exactly the accepted positions, so rejected garbage is
+    masked now and overwritten when the slot actually reaches those
+    positions. Page tables are data, not shape: K is static
+    (``serve.spec_k``) and this is ONE executable next to
+    ``decode_step``, so ``compile/recompiles == 0`` survives.
+
+    Paged layout only (``state.pages`` required): the candidate window
+    may run past the slot buffer for rows near their budget end, so the
+    write path runs through a sentinel-extended page table — overflow
+    positions drop instead of clamping into the last real page. Greedy
+    sampling only (the host gates speculation on ``do_sample=False``);
+    the jnp attention path only (the pallas decode kernel is T==1).
+
+    Returns ``(pool, state, cand [S, K+1], counts [S], finished [S])``:
+    the host appends ``cand[s, :counts[s]]`` per live slot; plain steps
+    are the ``counts <= 1`` degenerate case of the same harvest shape.
+    """
+    if state.pages is None:
+        raise ValueError(
+            "verify_step requires the paged pool layout (state.pages); "
+            "serve.speculation is gated on serve.kv_layout: paged"
+        )
+    S, K = proposals.shape
+    Tc = K + 1  # candidates forwarded: the free token + K proposals
+    T = state.valid.shape[1]
+    segments, seg_sizes = _segments_of(blocks)
+    flags = ArchFlags.for_spec(spec)
+
+    emitting = state.active & ~state.finished
+    # clamp proposals to the per-slot budget: t0 spends one token, so at
+    # most remaining-1 proposals can ever be accepted
+    remaining = jnp.maximum(state.max_new - state.generated, 0)
+    n = jnp.minimum(
+        jnp.clip(n_proposed.astype(jnp.int32), 0, K),
+        jnp.maximum(remaining - 1, 0),
+    )
+    n = jnp.where(emitting, n, 0)
+
+    # the free token: exactly decode_step's emission from the carried
+    # logits (eos suppression mirrored; greedy => argmax either way)
+    step_logits = state.logits
+    if config.eos_token_id >= 0 and config.min_new_tokens > 0:
+        suppress = state.generated < config.min_new_tokens
+        eos_col = step_logits[:, config.eos_token_id]
+        step_logits = step_logits.at[:, config.eos_token_id].set(
+            jnp.where(suppress, NEG_INF, eos_col)
+        )
+    key = _sampling_key(jax.random.PRNGKey(seed))
+    t0 = sample_token(key, step_logits, config.sampling)
+    cand = jnp.concatenate(
+        [t0[:, None], proposals.astype(jnp.int32)], axis=1
+    )  # [S, Tc]
+    cand = jnp.where(
+        emitting[:, None], cand, jnp.int32(config.pad_token_id)
+    ).astype(jnp.int32)
+
+    # logical causality over the buffer: candidate j of row s sees the
+    # committed positions (valid lanes) plus candidates 0..j — the
+    # prefix-suffix prefill bias with the committed prefix read from
+    # valid instead of recomputed from start offsets
+    buf = jnp.arange(T)[None, None, :]
+    j_idx = jnp.arange(Tc)[None, :, None]
+    off = state.offset[:, None, None]
+    cand_vis = (
+        (buf >= off) & (buf <= off + j_idx)
+        & emitting[:, None, None]
+    )
+    allowed = (state.valid[:, None, :] > 0) | cand_vis
+    num_pages, page_size = _pool_page_geometry(pool)
+    # sentinel-extend the table so overflow candidate positions (a row
+    # near its budget end still WRITES all Tc candidates) drop instead
+    # of clamping into the row's last real page; the extra key columns
+    # are masked below
+    extra = -(-Tc // page_size)
+    pt_step = jnp.where(
+        emitting[:, None], state.pages, jnp.int32(num_pages)
+    )
+    pt_v = jnp.concatenate(
+        [pt_step, jnp.full((S, extra), num_pages, jnp.int32)], axis=1
+    )
+    bias = jnp.concatenate(
+        [
+            jnp.where(allowed, 0.0, NEG_INF),
+            jnp.full((S, Tc, extra * page_size), NEG_INF),
+        ],
+        axis=-1,
+    ).astype(jnp.float32)[:, None]  # [S, 1, Tc, T + extra*ps]
+
+    positions = state.pos[:, None] + jnp.arange(Tc)[None, :]  # [S, Tc]
+    h = embed_tokens(embed, spec, cand, positions, compute_dtype)
+    new_pool = []
+    for seg, size, (k_c, v_c) in zip(segments, seg_sizes, pool):
+        for i in range(size):
+            p_i = jax.tree_util.tree_map(lambda x, i=i: x[i], seg)
+            h, (k_l, v_l) = block_apply(
+                spec, flags, p_i, h, bias, positions,
+                kv_cache=(_kv_layer(k_c, i), _kv_layer(v_c, i)),
+                cache_row_offsets=state.offset,
+                page_table=pt_v, page_size=page_size,
+                attention_fn=attention_fn,
+            )
+            k_c = _kv_set_layer(k_c, i, k_l)
+            v_c = _kv_set_layer(v_c, i, v_l)
+        new_pool.append((k_c, v_c))
+    h_normed = layer_norm(ln_f, h, spec.layer_norm_epsilon)
+    L = project_logits(embed, spec, h_normed)  # [S, Tc, V]
+
+    # acceptance: proposal j (emitted index j, 1-based over proposals)
+    # survives iff it matches the greedy token of the distribution after
+    # candidate j-1 AND every earlier proposal survived
+    jpos = jnp.arange(1, K + 1)[None, :]  # [1, K] emitted index of prop j
+    Lm = L[:, :K]  # [S, K, V]: dist following cand_0..cand_{K-1}
+    if config.eos_token_id >= 0 and config.min_new_tokens > 0:
+        sup = (state.generated[:, None] + jpos) < config.min_new_tokens
+        eos_col = Lm[:, :, config.eos_token_id]
+        Lm = Lm.at[:, :, config.eos_token_id].set(
+            jnp.where(sup, NEG_INF, eos_col)
+        )
+    greedy = jnp.argmax(Lm, axis=-1).astype(jnp.int32)  # [S, K]
+    match = (proposals.astype(jnp.int32) == greedy) & (jpos <= n[:, None])
+    m = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [S]
+
+    # emitted run: cand_0..cand_m, truncated at (and including) the
+    # first eos among them; counts gate everything downstream
+    i_idx = jnp.arange(Tc)[None, :]
+    within = i_idx <= m[:, None]
+    if config.eos_token_id >= 0:
+        is_eos = (cand == config.eos_token_id) & within
+    else:
+        is_eos = jnp.zeros_like(within)
+    eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+        - is_eos.astype(jnp.int32)  # exclusive cumsum: eos count BEFORE i
+    emit_mask = within & (eos_before == 0) & emitting[:, None]
+    counts = emit_mask.sum(axis=1).astype(jnp.int32)  # [S] in 0..K+1
+
+    finished = state.finished
+    if config.eos_token_id >= 0:
+        finished = finished | (emitting & (is_eos & emit_mask).any(axis=1))
+    generated = state.generated + counts
+    finished = finished | (state.active & (generated >= state.max_new))
+
+    # the valid-lane rollback: exactly the accepted candidate positions
+    # become valid; rejected writes stay masked and are overwritten when
+    # the slot genuinely reaches them
+    rows2 = jnp.arange(S)[:, None]
+    cols = state.offset[:, None] + jnp.arange(Tc)[None, :]
+    valid = state.valid.at[rows2, cols].set(
+        emit_mask.astype(jnp.int32), mode="drop"
+    )
+
+    # carried logits advance to the distribution after the LAST emitted
+    # token — L[s, counts-1] is conditioned on exactly the greedy prefix,
+    # so the next step (plain or speculative) resumes bit-identically
+    last = jnp.maximum(counts - 1, 0)
+    next_logits = L[jnp.arange(S), last]  # [S, V]
+    next_logits = jnp.where(
+        emitting[:, None], next_logits, state.logits
+    )
+
+    new_state = SlotState(
+        valid=valid,
+        offset=state.offset + counts,
+        pos=state.pos + counts,
+        generated=generated,
+        max_new=state.max_new,
+        active=state.active,
+        finished=finished,
+        logits=next_logits,
+        pages=state.pages,
+    )
+    return tuple(new_pool), new_state, cand, counts, finished
+
+
 def decode_step(
     spec: ModelSpec,
     blocks: Params,

@@ -143,6 +143,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--degrade-step-ms", type=float, default=None,
                    help="adaptive admission: halve the queue bound "
                         "while a decode step exceeds this (0 = off)")
+    p.add_argument("--speculation", choices=("off", "lookup", "draft"),
+                   default=None,
+                   help="speculative decoding tier: 'lookup' proposes "
+                        "from a draft-free n-gram index over each "
+                        "request's own history + the radix cache, "
+                        "'draft' from a small draft model "
+                        "(--spec-draft-checkpoint); greedy verification "
+                        "keeps output bit-identical to 'off'. Requires "
+                        "--kv-layout paged and greedy decode")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="proposed tokens verified per slot per "
+                        "speculative step (static shape; 3-8 fits most "
+                        "traces)")
+    p.add_argument("--spec-draft-checkpoint", default=None,
+                   help="draft-model checkpoint directory for "
+                        "--speculation draft")
     p.add_argument("--no-request-tracing", action="store_true",
                    help="disable per-request lifecycle tracing (the "
                         "serve/ttft|itl|goodput SLO family and the "
@@ -185,7 +201,10 @@ def serve_config_from_args(args) -> ServeConfig:
                        ("max_replays", "max_replays"),
                        ("drain_timeout", "drain_timeout"),
                        ("watch_checkpoints", "watch_checkpoints"),
-                       ("degrade_step_ms", "degrade_step_ms")):
+                       ("degrade_step_ms", "degrade_step_ms"),
+                       ("speculation", "speculation"),
+                       ("spec_k", "spec_k"),
+                       ("spec_draft_checkpoint", "spec_draft_checkpoint")):
         value = getattr(args, flag)
         if value is not None:
             setattr(cfg, attr, value)

@@ -185,6 +185,29 @@ class ServeConfig:
         matvec (the scale factors out of the contraction). Halves
         resident block weights — the gpt-j-6B headroom knob. Embeddings,
         lm_head, layernorms, and biases stay full precision.
+    :param speculation: speculative-decoding tier: ``"off"`` (default)
+        decodes one token per step; ``"lookup"`` proposes up to
+        ``spec_k`` continuation tokens per slot from a draft-free n-gram
+        index over the request's own prompt + committed history (backed
+        by the radix cache's committed blocks) and verifies them in one
+        batched ``verify_step`` pass; ``"draft"`` proposes with a small
+        draft model (``spec_draft_checkpoint``) instead. Greedy
+        verification keeps output BIT-IDENTICAL to ``off`` — the knob
+        trades nothing but the verify pass's FLOPs. Requires
+        ``kv_layout: paged`` and greedy decode (``do_sample: false``).
+    :param spec_k: proposed tokens verified per slot per speculative
+        step (static — one more compiled executable, zero steady-state
+        recompiles). 3-8 fits most traces; past the typical acceptance
+        run length, extra k only pads the verify pass.
+    :param spec_ngram_max: longest history suffix n-gram the lookup
+        tier matches on (longer grams propose first — fewer, better
+        matches).
+    :param spec_draft_checkpoint: draft-model checkpoint directory for
+        ``speculation: draft``, restored through the same shard-aware
+        partial-restore path as the serving engine.
+    :param spec_index_max_keys: per-slot LRU bound on the lookup tier's
+        n-gram match keys, so a long-lived slot's host index cannot grow
+        unboundedly.
     """
 
     buckets: List[List[int]] = field(
@@ -232,6 +255,13 @@ class ServeConfig:
     #: effective priority level, so a saturating high-priority stream
     #: cannot starve low-priority tenants forever (0 = aging off)
     priority_aging_rounds: int = 64
+    #: speculative decoding (docs "Speculative decoding"): proposal
+    #: tier + how many tokens one verify pass scores per slot
+    speculation: str = "off"
+    spec_k: int = 4
+    spec_ngram_max: int = 3
+    spec_draft_checkpoint: Optional[str] = None
+    spec_index_max_keys: int = 512
 
     @classmethod
     def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ServeConfig":
@@ -442,6 +472,34 @@ class InferenceEngine:
                 f"serve.weights_dtype '{self.serve.weights_dtype}' is "
                 f"not one of: bf16, int8"
             )
+        if self.serve.speculation not in ("off", "lookup", "draft"):
+            raise ValueError(
+                f"serve.speculation '{self.serve.speculation}' is not "
+                f"one of: off, lookup, draft"
+            )
+        if self.serve.spec_k < 1:
+            raise ValueError(
+                f"serve.spec_k={self.serve.spec_k} must be >= 1 "
+                f"(disable speculation with serve.speculation: off)"
+            )
+        if self.serve.spec_ngram_max < 1:
+            raise ValueError(
+                f"serve.spec_ngram_max={self.serve.spec_ngram_max} "
+                f"must be >= 1"
+            )
+        if self.serve.spec_index_max_keys < 1:
+            raise ValueError(
+                f"serve.spec_index_max_keys="
+                f"{self.serve.spec_index_max_keys} must be >= 1 "
+                f"(the per-slot n-gram index needs at least one key)"
+            )
+        if (self.serve.speculation == "draft"
+                and not self.serve.spec_draft_checkpoint):
+            raise ValueError(
+                "serve.speculation 'draft' needs "
+                "serve.spec_draft_checkpoint (the draft model to "
+                "propose with) — or use speculation: lookup"
+            )
         if self.serve.kv_layout != "paged":
             if self.serve.attention == "pallas":
                 raise ValueError(
@@ -455,6 +513,13 @@ class InferenceEngine:
                     "serve.kv_dtype 'int8' quantizes PAGED pool pages; "
                     f"kv_layout '{self.serve.kv_layout}' supports bf16 "
                     "only"
+                )
+            if self.serve.speculation != "off":
+                raise ValueError(
+                    "serve.speculation verifies candidates through the "
+                    "PAGED pool's per-slot page tables; kv_layout "
+                    f"'{self.serve.kv_layout}' cannot re-claim rejected "
+                    "writes — use kv_layout: paged or speculation: off"
                 )
         from trlx_tpu.serve.layouts import build_serve_mesh
 
@@ -512,6 +577,14 @@ class InferenceEngine:
             pad_token_id=pad,
             min_new_tokens=0,
         )
+        if self.serve.speculation != "off" \
+                and self._gen_base.sampling.do_sample:
+            raise ValueError(
+                "serve.speculation requires greedy decode "
+                "(gen_kwargs do_sample: false): acceptance compares "
+                "proposals against the argmax stream — under sampling "
+                "the verified output would not match plain decode"
+            )
         self.pad_token_id = pad
         import threading
 

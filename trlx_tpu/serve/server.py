@@ -133,6 +133,14 @@ _SERVE_COUNTERS = (
     "serve/shed_quota",
     "serve/brownout_clamped",
     "serve/brownout_entries",
+    # speculative decoding (docs "Speculative decoding"): proposed
+    # tokens shipped to verify_step, proposals accepted (== decode
+    # steps the target model never ran under greedy verify), and
+    # proposal-side faults that fell a step back to plain decode
+    "serve/spec_proposed",
+    "serve/spec_accepted",
+    "serve/spec_steps_saved",
+    "serve/spec_fallbacks",
 )
 
 #: proxy-hop ceiling: any sane fleet topology is 1-2 hops deep (client
@@ -655,6 +663,8 @@ class InferenceServer:
                 )
                 telemetry.set_gauge("serve/prefix_hit_rate", 0.0)
                 telemetry.set_gauge("serve/pages_per_request_p95", 0.0)
+            if self.engine.serve.speculation != "off":
+                telemetry.set_gauge("serve/spec_acceptance_rate", 0.0)
         telemetry.set_gauge(
             "serve/model_version", self.engine.model_version
         )

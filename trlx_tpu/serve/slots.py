@@ -189,6 +189,14 @@ class SlotPoolRuntime:
         )()
         self._prefill_fns = {}  # (Bp, P[, suffix]) -> aot_jit'd closure
         self._step_fn = None
+        #: speculation: k proposed tokens verified per step (0 = off);
+        #: K is STATIC, so verify_step is one more executable compiled
+        #: at warmup — never a steady-state signature change
+        self.spec_k = (
+            int(engine.serve.spec_k)
+            if engine.serve.speculation != "off" else 0
+        )
+        self._verify_step_fn = None
         self.warmed = False
 
     def _view_shardings(self):
@@ -302,6 +310,42 @@ class SlotPoolRuntime:
             )
         return self._step_fn
 
+    def _verify_fn(self):
+        """The speculation verifier: decode_step's shape with K+1
+        candidates per slot — always the jnp attention path (the pallas
+        decode kernel is T==1; the verify pass amortizes the gather over
+        K+1 query positions anyway)."""
+        if self._verify_step_fn is None:
+            from trlx_tpu.models.generation import verify_step
+            from trlx_tpu.utils.aotjit import aot_jit
+
+            spec = self.engine.spec
+            cfg = self.engine._gen_base
+            compute = self.engine._compute_dtype
+
+            def run(blocks, embed, ln_f, pool, state, seed,
+                    proposals, n_proposed):
+                return verify_step(
+                    spec, blocks, embed, ln_f, pool, state, seed,
+                    proposals, n_proposed, cfg, compute_dtype=compute,
+                )
+
+            self._verify_step_fn = aot_jit(
+                run, donate_argnums=(3, 4) if self._donate else (),
+                in_shardings=(
+                    *self._view_shardings(),
+                    self._pool_shardings, self._state_shardings,
+                    self._host_sharding, self._host_sharding,
+                    self._host_sharding,
+                ),
+                out_shardings=(
+                    self._pool_shardings, self._state_shardings,
+                    self._host_sharding, self._host_sharding,
+                    self._host_sharding,
+                ),
+            )
+        return self._verify_step_fn
+
     # -- spans ------------------------------------------------------------ #
 
     def prefill_span(self, bucket, suffix: bool = False) -> str:
@@ -309,6 +353,7 @@ class SlotPoolRuntime:
         return f"serve/prefill{'_sfx' if suffix else ''}_b{Bp}p{P}"
 
     STEP_SPAN = "serve/slot_step"
+    VERIFY_SPAN = "serve/spec_verify"
 
     # -- device calls ------------------------------------------------------ #
 
@@ -351,6 +396,25 @@ class SlotPoolRuntime:
                 np.int32(seed),
             )
             return jax.device_get((tok, emitted, finished))
+
+    def verify(self, seed: int, proposals: np.ndarray,
+               n_proposed: np.ndarray):
+        """One speculative verification step for every slot: scores the
+        K proposals + the free token in one batched pass. Returns
+        host-side (cand [S, K+1], counts [S], finished [S]) — each
+        slot emits ``cand[s, :counts[s]]``."""
+        import jax
+
+        e = self.engine
+        fn = self._verify_fn()
+        with telemetry.span(self.VERIFY_SPAN):
+            self.pool, self.state, cand, counts, finished = fn(
+                e.blocks, e.embed, e.ln_f, self.pool, self.state,
+                np.int32(seed),
+                np.ascontiguousarray(proposals, np.int32),
+                np.asarray(n_proposed, np.int32),
+            )
+            return jax.device_get((cand, counts, finished))
 
     def reset_lanes(self) -> None:
         """Fresh all-free per-slot lanes, REUSING the pool buffers — the
@@ -412,6 +476,15 @@ class SlotPoolRuntime:
                         suffix=suffix,
                     )
         self.step(0)
+        if self.spec_k > 0:
+            # compile the verifier against the all-free pool: every row
+            # is non-emitting, so the sentinel-gated table drops every
+            # write and the pass is pure shape
+            self.verify(
+                0,
+                np.zeros((self.num_slots, self.spec_k), np.int32),
+                np.zeros((self.num_slots,), np.int32),
+            )
         tel = telemetry.current()
         if tel is not None:
             spans = [
@@ -420,13 +493,16 @@ class SlotPoolRuntime:
                 for Bp in extents
                 for suffix in variants
             ] + [self.STEP_SPAN]
+            if self.spec_k > 0:
+                spans.append(self.VERIFY_SPAN)
             for span in spans:
                 hist = tel.registry.hists.get(f"time/{span}")
                 if hist is not None and hist.first is not None:
                     latencies[span] = hist.first
         self.warmed = True
         telemetry.set_gauge(
-            "serve/slot_programs_warmed", len(self._prefill_fns) + 1
+            "serve/slot_programs_warmed",
+            len(self._prefill_fns) + 1 + (1 if self.spec_k > 0 else 0),
         )
         return latencies
 
@@ -457,7 +533,8 @@ class SlotScheduler:
     """
 
     def __init__(self, engine, max_queue: Optional[int] = None,
-                 run_supervisor=None, slots: Optional[int] = None):
+                 run_supervisor=None, slots: Optional[int] = None,
+                 draft=None):
         from trlx_tpu.serve.paged import RadixCache
 
         self.engine = engine
@@ -497,6 +574,25 @@ class SlotScheduler:
         # reset by _run after each step's record lands in the ring
         self._fr_admitted = 0
         self._fr_evicted = 0
+        # -- speculation (docs "Speculative decoding") ------------------ #
+        #: propose -> verify -> accept per step when serve.speculation
+        #: is on; per-slot host state lives in _speculators (lookup
+        #: tier), dropped at harvest/replay so host memory is bounded
+        self._spec_mode = cfg.speculation
+        self.spec_k = self.runtime.spec_k
+        self._speculators: Dict[int, object] = {}
+        self._draft = draft  # tests inject; built lazily otherwise
+        if (self._spec_mode == "draft" and draft is None
+                and cfg.spec_draft_checkpoint):
+            from trlx_tpu.serve.speculate import DraftProposer
+
+            self._draft = DraftProposer.from_checkpoint(
+                cfg.spec_draft_checkpoint, engine, self.spec_k
+            )
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._fr_spec_proposed = 0
+        self._fr_spec_accepted = 0
         # -- crash-only lifecycle state (docs "Fault tolerance") -------- #
         self._draining = False  # guarded-by: _cond
         self._drain_deadline = 0.0
@@ -558,6 +654,7 @@ class SlotScheduler:
             self._queue.clear()
         live = list(self._live.values())
         self._live.clear()
+        self._speculators.clear()
         self._free = list(range(self.runtime.num_slots))
         for req in pending + [s.request for s in live]:
             req.error = RuntimeError("serve slot scheduler stopped")
@@ -746,6 +843,10 @@ class SlotScheduler:
         }
         if self.cache is not None:
             out["pages_free"] = self.cache.free_pages()
+        if self.spec_k > 0:
+            out["spec_acceptance_rate"] = round(
+                self._spec_acceptance_rate(), 4
+            )
         return out
 
     def step_p50_s(self) -> float:
@@ -843,6 +944,22 @@ class SlotScheduler:
                 self._starved = True
                 return
 
+    def _spawn_speculator(self, slot: int, history: List[int]) -> None:
+        """Lookup-tier per-slot state: the n-gram index over the
+        request's own prompt + journaled committed tokens. Bounded
+        (``serve.spec_index_max_keys`` LRU) and dropped at harvest/
+        replay — the slow soaks assert the map drains to empty."""
+        if self.spec_k <= 0 or self._spec_mode != "lookup":
+            return
+        from trlx_tpu.serve.speculate import SlotSpeculator
+
+        cfg = self.engine.serve
+        self._speculators[slot] = SlotSpeculator(
+            history, self.spec_k,
+            ngram_max=int(getattr(cfg, "spec_ngram_max", 3)),
+            max_keys=int(getattr(cfg, "spec_index_max_keys", 512)),
+        )
+
     def _prefill_batch(self, batch: List[Request], P: int, extents) -> bool:
         """Prefill one admission batch; returns False when the paged
         allocator ran dry and part of the batch went back to the queue."""
@@ -881,6 +998,7 @@ class SlotScheduler:
             live.tokens = list(r.committed)
             self._live[s] = live
             self.events.append(("admit", s, r))
+            self._spawn_speculator(s, r.tokens + r.committed)
         self._fr_admitted += len(batch)
         telemetry.inc("serve/admissions", len(batch))
         for r in batch:
@@ -987,6 +1105,7 @@ class SlotScheduler:
             live.tokens = list(r.committed)
             self._live[s] = live
             self.events.append(("admit", s, r))
+            self._spawn_speculator(s, r.tokens + r.committed)
             saved += len(matched) * ps
             self._prompt_tokens_total += len(toks)
             telemetry.observe("serve/pages_per_request", len(pages))
@@ -1050,24 +1169,122 @@ class SlotScheduler:
             )
         return stats
 
+    def _clamp_proposal(self, live: _LiveSlot, n: int) -> int:
+        """Cap a slot's proposal at the request's remaining budget: the
+        free token spends one, so at most ``remaining - 1`` proposals
+        could ever be accepted (the device clamps identically — this
+        just skips shipping doomed proposals)."""
+        remaining = live.request.max_new_tokens - len(live.tokens)
+        return max(0, min(n, self.spec_k, remaining - 1))
+
+    def _spec_acceptance_rate(self) -> float:
+        return self._spec_accepted_total / max(self._spec_proposed_total, 1)
+
+    def _gather_proposals(self):
+        """Host half of the propose->verify->accept loop: one [S, K]
+        proposal batch from the active tier — per-slot n-gram lookup
+        (backed by the radix cache's committed blocks) or the draft
+        model. Returns ``(proposals, n_proposed)`` or None when every
+        row is dry; None falls the step back to plain ``decode_step``,
+        so the worst case is exactly today's behavior. Any
+        proposal-side fault (including the ``serve_speculate`` chaos
+        seam) also returns None: nothing was dispatched yet, so nothing
+        is half-committed — the step completes unspeculated and
+        ``serve/spec_fallbacks`` counts the event."""
+        try:
+            chaos.maybe_inject("serve_speculate")
+            S, K = self.runtime.num_slots, self.spec_k
+            props = np.zeros((S, K), np.int32)
+            nprops = np.zeros((S,), np.int32)
+            if self._spec_mode == "draft" and self._draft is not None:
+                histories: List[Optional[List[int]]] = [None] * S
+                for s, live in self._live.items():
+                    histories[s] = live.request.tokens + live.tokens
+                drafted = self._draft.propose(histories)
+                for s, live in self._live.items():
+                    p = drafted[s][:K]
+                    n = self._clamp_proposal(live, len(p))
+                    props[s, :n] = p[:n]
+                    nprops[s] = n
+            else:
+                for s, live in self._live.items():
+                    sp = self._speculators.get(s)
+                    if sp is None:
+                        continue
+                    p = sp.propose(self.cache)[:K]
+                    n = self._clamp_proposal(live, len(p))
+                    props[s, :n] = p[:n]
+                    nprops[s] = n
+            if not nprops.any():
+                return None
+            return props, nprops
+        except Exception:
+            telemetry.inc("serve/spec_fallbacks")
+            return None
+
     def _step(self) -> None:
+        plan = None
         with supervisor.phase("serve_decode"):
             chaos.maybe_inject("serve_decode")
             seed = self.engine.serve.seed + self._step_counter
             self._step_counter += 1
-            tok, emitted, finished = self.runtime.step(seed)
+            if self.spec_k > 0 and self._live:
+                plan = self._gather_proposals()
+            if plan is not None:
+                # speculative step: K proposals + the free token score
+                # in ONE verify pass; each slot emits its longest
+                # greedy-matching prefix (>= 1 token — never worse than
+                # a plain step)
+                props, nprops = plan
+                cand, counts, finished = self.runtime.verify(
+                    seed, props, nprops
+                )
+                counts = np.asarray(counts, np.int32)
+                proposed = int(nprops.sum())
+                accepted = int(np.maximum(counts - 1, 0).sum())
+                span = self.runtime.VERIFY_SPAN
+            else:
+                tok, emitted, finished = self.runtime.step(seed)
+                # plain decode is the counts <= 1 degenerate case of the
+                # same harvest shape
+                cand = np.asarray(tok)[:, None]
+                counts = np.asarray(emitted).astype(np.int32)
+                proposed = accepted = 0
+                span = self.runtime.STEP_SPAN
             supervisor.beat()
         if self._starved:
             telemetry.inc("serve/preempted_steps")
+        if plan is not None:
+            if proposed:
+                telemetry.inc("serve/spec_proposed", proposed)
+            if accepted:
+                # each accepted proposal is one decode_step the target
+                # model never ran — under greedy verify the two counters
+                # are equal by construction
+                telemetry.inc("serve/spec_accepted", accepted)
+                telemetry.inc("serve/spec_steps_saved", accepted)
+            self._spec_proposed_total += proposed
+            self._spec_accepted_total += accepted
+            self._fr_spec_proposed += proposed
+            self._fr_spec_accepted += accepted
+            telemetry.set_gauge(
+                "serve/spec_acceptance_rate", self._spec_acceptance_rate()
+            )
         done_at = monotonic()
         emitted_total = 0
         for slot in list(self._live):
             live = self._live[slot]
-            if emitted[slot]:
-                live.tokens.append(int(tok[slot]))
-                emitted_total += 1
+            c = int(counts[slot])
+            if c:
+                toks = [int(t) for t in cand[slot, :c]]
+                live.tokens.extend(toks)
+                emitted_total += c
+                sp = self._speculators.get(slot)
+                if sp is not None:
+                    sp.append(toks)
                 if live.request.trace is not None:
-                    live.request.trace.note_token(done_at)
+                    for _ in range(c):
+                        live.request.trace.note_token(done_at)
             if finished[slot]:
                 req = live.request
                 req.result = live.tokens
@@ -1077,6 +1294,7 @@ class SlotScheduler:
                     req.trace.complete("slots", self._slo_s)
                 req.done.set()
                 del self._live[slot]
+                self._speculators.pop(slot, None)
                 self._free.append(slot)
                 if self.cache is not None:
                     # committed (trie-owned) pages stay cached at
@@ -1094,7 +1312,7 @@ class SlotScheduler:
             telemetry.inc("serve/generated_tokens", emitted_total)
             tel = telemetry.current()
             if tel is not None:
-                hist = tel.registry.hists.get(f"time/{self.runtime.STEP_SPAN}")
+                hist = tel.registry.hists.get(f"time/{span}")
                 if hist is not None and hist.last > 0:
                     telemetry.set_gauge(
                         "serve/tokens_per_sec", emitted_total / hist.last
@@ -1122,6 +1340,7 @@ class SlotScheduler:
         lanes, keep the loop serving."""
         live = list(self._live.values())
         self._live.clear()
+        self._speculators.clear()
         self._free = list(range(self.runtime.num_slots))
         telemetry.inc("serve/request_errors", len(live))
         # contain FIRST, signal last: a waiter released by done.set()
@@ -1203,6 +1422,10 @@ class SlotScheduler:
             return
         live = list(self._live.values())
         self._live.clear()
+        # speculation state is derived from per-slot histories that are
+        # about to be re-journaled — replay re-admission rebuilds it
+        # fresh, so a poisoned step can never leak a stale index
+        self._speculators.clear()
         self._free = list(range(self.runtime.num_slots))
         try:
             self.runtime.reset_lanes()
@@ -1265,6 +1488,7 @@ class SlotScheduler:
             telemetry.set_gauge("serve/queue_depth", 0)
         live = list(self._live.values())
         self._live.clear()
+        self._speculators.clear()
         self._free = list(range(self.runtime.num_slots))
         victims = pending + [s.request for s in live]
         if victims:
@@ -1422,6 +1646,7 @@ class SlotScheduler:
         here so each record owns exactly its step's churn."""
         if self.flight is None:
             self._fr_admitted = self._fr_evicted = 0
+            self._fr_spec_proposed = self._fr_spec_accepted = 0
             return
         rec = {
             "step": self._step_counter,
@@ -1434,8 +1659,14 @@ class SlotScheduler:
         }
         if self.cache is not None:
             rec["pages_free"] = self.cache.free_pages()
+        if self.spec_k > 0:
+            # a speculation regression (acceptance collapsing to 0) must
+            # be visible in a stall dump, not only in the counters
+            rec["spec_proposed"] = self._fr_spec_proposed
+            rec["spec_accepted"] = self._fr_spec_accepted
         self.flight.record(**rec)
         self._fr_admitted = self._fr_evicted = 0
+        self._fr_spec_proposed = self._fr_spec_accepted = 0
 
     def dump_flight_recorder(self) -> None:
         """Supervisor stall hook (``RunSupervisor.add_dump_fn``): print
@@ -1485,6 +1716,13 @@ class SlotScheduler:
             "flight_dumps": self.flight.dumps if self.flight else 0,
             "kv": self.pool_stats(),
             "mesh": self.engine.mesh_info(),
+            "speculation": {
+                "mode": self._spec_mode,
+                "k": self.spec_k,
+                "proposed": self._spec_proposed_total,
+                "accepted": self._spec_accepted_total,
+                "acceptance_rate": round(self._spec_acceptance_rate(), 4),
+            },
         }
 
     def _run(self) -> None:

@@ -1,0 +1,211 @@
+"""Speculation proposal tiers for the slot engine.
+
+The verification half of speculative decoding lives on-device
+(:func:`trlx_tpu.models.generation.verify_step`); this module is the
+host half — WHERE the k candidate tokens come from. Two tiers, one
+contract: given a slot's token history, return up to ``serve.spec_k``
+continuation tokens, or nothing (the scheduler falls back to plain
+``decode_step``, so a dry proposer costs exactly today's behavior).
+
+Tier ``lookup`` (draft-free, Saxena's prompt-lookup): an n-gram index
+over the request's OWN prompt + committed history (:class:`NgramIndex`
+inside :class:`SlotSpeculator`), backed by the radix cache's committed
+blocks (``RadixCache.peek_continuation``) for cross-request shared
+prefixes. Zero model cost; ideal for RLHF rollout and templated/
+retrieval traces where the continuation literally appears earlier.
+
+Tier ``draft`` (:class:`DraftProposer`): a small model restored through
+the SAME shard-aware partial-restore path as the serving engine
+(``InferenceEngine.from_checkpoint``), decoding k ahead for all live
+slots in one fixed-shape compiled ``generate`` call. Costs draft FLOPs
+every step but proposes on novel text where lookup is dry.
+
+Per-slot host state is bounded: the n-gram index LRU-evicts above
+``serve.spec_index_max_keys`` match keys and the whole speculator is
+dropped at harvest/replay (the slow serve soaks assert the map drains),
+so long-lived serving can't grow host memory.
+"""
+
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["NgramIndex", "SlotSpeculator", "DraftProposer"]
+
+
+class NgramIndex:
+    """Suffix-gram -> continuation-start index over one growing token
+    history, LRU-bounded at ``max_keys`` match keys.
+
+    For every position ``c`` in the history, the grams of length
+    ``1..ngram_max`` ENDING just before ``c`` map to ``c`` (latest
+    occurrence wins — recency beats frequency on decode traces). Lookup
+    tries the longest suffix gram of the current history first. The
+    cursor ``_upto`` only ever indexes positions that HAVE a
+    continuation token, so the history's own tail gram can never match
+    itself and propose stale text.
+    """
+
+    __slots__ = ("ngram_max", "max_keys", "_grams", "_upto")
+
+    def __init__(self, ngram_max: int = 3, max_keys: int = 512):
+        if ngram_max < 1:
+            raise ValueError(f"ngram_max={ngram_max} must be >= 1")
+        if max_keys < 1:
+            raise ValueError(f"max_keys={max_keys} must be >= 1")
+        self.ngram_max = ngram_max
+        self.max_keys = max_keys
+        self._grams: "OrderedDict[Tuple[int, ...], int]" = OrderedDict()
+        self._upto = 0  # history positions < _upto are indexed
+
+    def __len__(self) -> int:
+        return len(self._grams)
+
+    def _put(self, gram: Tuple[int, ...], cont: int) -> None:
+        if gram in self._grams:
+            del self._grams[gram]  # re-insert at LRU tail
+        self._grams[gram] = cont
+        while len(self._grams) > self.max_keys:
+            self._grams.popitem(last=False)
+
+    def extend(self, history: Sequence[int]) -> None:
+        """Index the not-yet-indexed region of ``history`` (the same
+        list the speculator appends to — call after every append)."""
+        for cont in range(max(self._upto, 1), len(history)):
+            for n in range(1, self.ngram_max + 1):
+                if cont - n < 0:
+                    break
+                self._put(tuple(history[cont - n:cont]), cont)
+        self._upto = max(self._upto, len(history))
+
+    def lookup(self, history: Sequence[int]) -> Optional[int]:
+        """Continuation start for the longest indexed suffix gram of
+        ``history``, LRU-touching the hit. ``None`` when dry."""
+        for n in range(min(self.ngram_max, len(history)), 0, -1):
+            gram = tuple(history[-n:])
+            cont = self._grams.get(gram)
+            if cont is not None:
+                self._grams.move_to_end(gram)
+                return cont
+        return None
+
+
+class SlotSpeculator:
+    """Per-slot lookup-tier state: the request's full token history
+    (prompt + every committed emission) plus its bounded n-gram index.
+    Created at admission, fed at harvest, dropped at eviction/replay."""
+
+    __slots__ = ("history", "spec_k", "index")
+
+    def __init__(self, prompt_tokens: Sequence[int], spec_k: int,
+                 ngram_max: int = 3, max_keys: int = 512):
+        self.history: List[int] = list(prompt_tokens)
+        self.spec_k = spec_k
+        self.index = NgramIndex(ngram_max, max_keys)
+        self.index.extend(self.history)
+
+    def append(self, tokens: Sequence[int]) -> None:
+        """Commit freshly accepted tokens into history + index."""
+        self.history.extend(int(t) for t in tokens)
+        self.index.extend(self.history)
+
+    def propose(self, cache=None) -> List[int]:
+        """Up to ``spec_k`` continuation tokens: own-history n-gram
+        match first, then the radix cache's committed blocks
+        (read-only ``peek_continuation``), else nothing."""
+        cont = self.index.lookup(self.history)
+        if cont is not None:
+            prop = self.history[cont:cont + self.spec_k]
+            if prop:
+                return list(prop)
+        if cache is not None:
+            return list(cache.peek_continuation(self.history, self.spec_k))
+        return []
+
+
+class DraftProposer:
+    """Draft-model proposal tier: a small engine decoding ``spec_k``
+    ahead for every live slot in one fixed-shape compiled call.
+
+    The draft decodes greedily from the last ``window`` tokens of each
+    slot's history, left-padded into a fixed ``(num_slots, window)``
+    batch — one ``jax.jit`` program regardless of which slots are live,
+    so speculation never adds to the serve engine's recompile budget.
+    Rows without a live slot carry a single pad token and are ignored.
+    """
+
+    def __init__(self, engine, spec_k: int, batch: int,
+                 window: Optional[int] = None):
+        import jax
+
+        from trlx_tpu.models.generation import generate
+        from trlx_tpu.ops.sampling import SamplingParams
+
+        self.engine = engine
+        self.spec_k = int(spec_k)
+        self.batch = int(batch)
+        n_pos = engine.spec.n_positions
+        self.window = int(window) if window is not None \
+            else max(1, min(32, n_pos - self.spec_k))
+        if self.window + self.spec_k > n_pos:
+            raise ValueError(
+                f"draft window {self.window} + spec_k {self.spec_k} "
+                f"exceeds draft n_positions {n_pos}"
+            )
+        cfg = engine._gen_base._replace(
+            gen_size=self.spec_k,
+            eos_token_id=-1,  # verification owns termination
+            min_new_tokens=0,
+            sampling=SamplingParams(
+                temperature=1.0, top_k=0, top_p=1.0, do_sample=False,
+            ),
+        )
+        spec = engine.spec
+
+        def run(blocks, embed, ln_f, tokens, mask, key):
+            return generate(
+                spec, blocks, embed, ln_f, tokens, mask, key, cfg,
+                compute_dtype=engine._compute_dtype,
+            ).gen_tokens
+
+        self._run = jax.jit(run)
+        self._key = jax.random.PRNGKey(0)  # greedy: key is inert
+
+    @classmethod
+    def from_checkpoint(cls, path: str, serve_engine, spec_k: int):
+        """Restore the draft through the serving engine's shard-aware
+        partial-restore path, onto the same mesh/serve config family."""
+        from trlx_tpu.serve.engine import InferenceEngine
+
+        draft = InferenceEngine.from_checkpoint(
+            path, serve=serve_engine.serve,
+        )
+        return cls(draft, spec_k, serve_engine.slot_count())
+
+    def propose(self, histories: Sequence[Optional[Sequence[int]]]
+                ) -> List[List[int]]:
+        """Draft continuations for each history (``None`` rows are dead
+        slots). One fixed-shape device call; returns one k-token list
+        per input row (empty for dead rows)."""
+        import numpy as np
+
+        e = self.engine
+        W = self.window
+        tokens = np.zeros((self.batch, W), dtype=np.int32)
+        mask = np.zeros((self.batch, W), dtype=np.int32)
+        for i in range(self.batch):
+            h = histories[i] if i < len(histories) else None
+            if h:
+                tail = [int(t) for t in h[-W:]]
+                tokens[i, -len(tail):] = tail
+                mask[i, -len(tail):] = 1
+            else:
+                tokens[i, -1] = 0
+                mask[i, -1] = 1  # filler row: one real token
+        gen = np.asarray(self._run(
+            e.blocks, e.embed, e.ln_f, tokens, mask, self._key,
+        ))
+        out: List[List[int]] = []
+        for i in range(self.batch):
+            h = histories[i] if i < len(histories) else None
+            out.append([int(t) for t in gen[i]] if h else [])
+        return out

@@ -39,7 +39,12 @@ A schedule is a ``;``-separated list of rules::
   in-flight batch fails like pre-replay containment), and
   ``serve_reload`` (fired at checkpoint hot-swap application, before
   the candidate weights install — an ``exc`` drives the
-  rollback-to-old-version path, ``serve/reload_failures``). The fleet
+  rollback-to-old-version path, ``serve/reload_failures``), and
+  ``serve_speculate`` (fired inside the supervised ``serve_decode``
+  phase at proposal-gathering entry, before anything is dispatched to
+  the device — an ``exc`` falls that step back to plain decode with
+  nothing half-committed, ``serve/spec_fallbacks``; a ``hang`` is a
+  watchdog-attributable ``serve_decode`` stall). The fleet
   router (trlx_tpu.router) adds ``router_route`` (fired at request
   routing, before a replica is picked — an ``exc`` surfaces as the
   router's 500 error path without touching any backend), ``router_probe``
@@ -115,6 +120,7 @@ KNOWN_SEAMS = (
     "serve_quota",
     "serve_replay",
     "serve_reload",
+    "serve_speculate",
     # fleet-router seams (trlx_tpu.router; see the docstring's seam tour)
     "router_route",
     "router_probe",
